@@ -1,0 +1,39 @@
+"""edl_tpu — a TPU-native elastic deep-learning training framework.
+
+A ground-up redesign of the capabilities of PaddlePaddle EDL
+(reference: caihengyu520/edl) for TPU hardware:
+
+- Declarative ``TrainingJob`` specs (chips instead of GPUs) with an
+  elastic min/max worker range        (reference: pkg/apis/paddlepaddle/v1/types.go:36)
+- A cluster autoscaler that retargets every elastic job's worker count
+  to keep the fleet at a configured load
+                                      (reference: pkg/autoscaler.go:451-485)
+- A controller + per-job lifecycle state machine
+                                      (reference: pkg/controller.go:110,
+                                       pkg/updater/trainingJobUpdater.go:453)
+- An elastic training runtime built on JAX: ``jit``/``shard_map`` over a
+  ``jax.sharding.Mesh``, gradient all-reduce over ICI, and an in-place
+  mesh re-shard protocol instead of job restarts (replaces the
+  reference's external pserver/etcd runtime,
+                                      reference: docker/paddle_k8s:14-32)
+- An elastic data service with task leases + timeout redelivery
+  (the master task-queue analog,      reference: docker/paddle_k8s:28-31)
+
+The pserver architecture disappears: optimizer state is sharded in-mesh
+(FSDP/ZeRO) and gradients ride XLA collectives over ICI/DCN.
+"""
+
+__version__ = "0.1.0"
+
+from edl_tpu.api.job import (  # noqa: F401
+    JobPhase,
+    MasterSpec,
+    PserverSpec,
+    ResourceRequirements,
+    ResourceSpec,
+    TrainingJob,
+    TrainingJobSpec,
+    TrainingJobStatus,
+    WorkerSpec,
+)
+from edl_tpu.api.parser import JobParser  # noqa: F401

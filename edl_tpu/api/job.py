@@ -1,0 +1,309 @@
+"""TrainingJob — the declarative job spec (L0 API types).
+
+TPU-native redesign of the reference's TrainingJob CRD/TPR
+(reference: pkg/apis/paddlepaddle/v1/types.go:36-173,
+ pkg/resource/training_job.go:109-238). Differences, by design:
+
+- ``WorkerSpec`` (the trainer analog) asks for **TPU chips per worker**
+  instead of GPU limits; an ``accelerator_type`` names the slice family
+  (e.g. "v5e"). The elastic range stays ``min_replicas``/``max_replicas``
+  (reference: min-instance/max-instance, types.go:86-87).
+- ``PserverSpec`` is accepted for spec compatibility but maps to no
+  runtime process: optimizer/parameter state is sharded in-mesh
+  (FSDP/ZeRO over the ``jax.sharding.Mesh``). A non-zero pserver group
+  is tolerated and reported in validation warnings.
+- ``MasterSpec`` becomes the **coordinator**: the process that owns the
+  membership registry, barrier, task queue and reshard signaling
+  (replaces the reference's master + etcd sidecar,
+  reference: pkg/jobparser.go:167-227).
+- ``mesh`` describes the parallelism plan (dp/fsdp/tp/pp/sp/ep axis
+  sizes) — new, first-class; the reference only has pserver DP.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from edl_tpu.api.resources import ResourceRequirements, ResourceSpec
+
+try:
+    import yaml  # type: ignore
+
+    _HAVE_YAML = True
+except Exception:  # pragma: no cover
+    _HAVE_YAML = False
+
+API_VERSION = "edl-tpu.org/v1"
+KIND = "TrainingJob"
+
+DEFAULT_PORT = 7164  # reference: pkg/jobparser.go:50-51
+DEFAULT_IMAGE = "edl-tpu/job"  # reference default image, jobparser.go:59-60
+DEFAULT_PASSES = 1  # reference: pkg/jobparser.go:62-63
+DEFAULT_ACCELERATOR = "v5e"
+
+
+class JobPhase(str, enum.Enum):
+    """Job lifecycle phase (reference: pkg/apis/paddlepaddle/v1/types.go:95-106,
+    plus ``SCALING`` to surface in-place reshard — new in the TPU design)."""
+
+    NONE = ""
+    CREATING = "creating"
+    RUNNING = "running"
+    SCALING = "scaling"
+    SUCCEEDED = "succeeded"
+    FAILED = "failed"
+
+    def terminal(self) -> bool:
+        return self in (JobPhase.SUCCEEDED, JobPhase.FAILED)
+
+
+class ResourceState(str, enum.Enum):
+    """Per-child-resource state (reference: types.go:141-148)."""
+
+    NONE = ""
+    CREATING = "creating"
+    READY = "ready"
+    SUCCEEDED = "succeeded"
+    FAILED = "failed"
+
+
+@dataclass
+class MasterSpec:
+    """Coordinator spec (reference: MasterSpec, types.go:67-72). The
+    etcd-endpoint field becomes the coordinator address."""
+
+    coordinator_endpoint: str = ""
+    resources: ResourceRequirements = field(default_factory=ResourceRequirements)
+
+
+@dataclass
+class PserverSpec:
+    """Accepted for reference-spec compatibility (types.go:75-81); the TPU
+    runtime shards parameters/optimizer state in-mesh instead."""
+
+    min_replicas: int = 0
+    max_replicas: int = 0
+    resources: ResourceRequirements = field(default_factory=ResourceRequirements)
+
+
+@dataclass
+class MeshSpec:
+    """Parallelism plan: per-axis sizes of the device mesh each worker set
+    trains over. 0/absent axes are squeezed. New in the TPU design (the
+    reference's only strategy is pserver DP, SURVEY §2.5)."""
+
+    dp: int = 0  # data parallel (pure replication)
+    fsdp: int = 0  # fully-sharded DP (ZeRO-3 analog)
+    tp: int = 0  # tensor parallel
+    pp: int = 0  # pipeline parallel
+    sp: int = 0  # sequence/context parallel (ring attention)
+    ep: int = 0  # expert parallel (MoE)
+
+    def axis_sizes(self) -> Dict[str, int]:
+        return {
+            k: v
+            for k, v in (
+                ("dp", self.dp),
+                ("fsdp", self.fsdp),
+                ("tp", self.tp),
+                ("pp", self.pp),
+                ("sp", self.sp),
+                ("ep", self.ep),
+            )
+            if v > 1
+        }
+
+
+@dataclass
+class WorkerSpec:
+    """Elastic worker group (the trainer analog, reference:
+    TrainerSpec types.go:84-92). Each worker is one host process driving
+    ``tpu_chips`` chips; the elastic range is in workers."""
+
+    entrypoint: str = ""
+    workspace: str = ""
+    min_replicas: int = 1
+    max_replicas: int = 1
+    resources: ResourceRequirements = field(default_factory=ResourceRequirements)
+
+    @property
+    def chips_per_worker(self) -> int:
+        return self.resources.limits.tpu_chips or self.resources.requests.tpu_chips
+
+
+@dataclass
+class TrainingJobSpec:
+    """reference: TrainingJobSpec types.go:44-64."""
+
+    image: str = ""
+    host_network: bool = False
+    port: int = 0
+    ports_num: int = 0
+    fault_tolerant: bool = False
+    passes: int = 0
+    accelerator_type: str = ""
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    mesh: MeshSpec = field(default_factory=MeshSpec)
+    master: MasterSpec = field(default_factory=MasterSpec)
+    pserver: PserverSpec = field(default_factory=PserverSpec)
+    worker: WorkerSpec = field(default_factory=WorkerSpec)
+
+
+@dataclass
+class ResourceStatus:
+    state: ResourceState = ResourceState.NONE
+    replicas: int = 0
+    ready_replicas: int = 0
+    succeeded: int = 0
+    failed: int = 0
+
+
+@dataclass
+class TrainingJobStatus:
+    """reference: TrainingJobStatus types.go:151-173."""
+
+    phase: JobPhase = JobPhase.NONE
+    reason: str = ""
+    master: ResourceStatus = field(default_factory=ResourceStatus)
+    worker: ResourceStatus = field(default_factory=ResourceStatus)
+    parallelism: int = 0  # current worker target (trainer Job .Spec.Parallelism analog)
+    reshard_count: int = 0  # elastic reshard events so far (new: observability)
+    last_reshard_stall_s: float = 0.0
+
+
+@dataclass
+class TrainingJob:
+    """The job object: metadata + spec + status
+    (reference: types.go:36-42)."""
+
+    name: str
+    namespace: str = "default"
+    spec: TrainingJobSpec = field(default_factory=TrainingJobSpec)
+    status: TrainingJobStatus = field(default_factory=TrainingJobStatus)
+    labels: Dict[str, str] = field(default_factory=dict)
+
+    # -- predicates (reference: pkg/resource/training_job.go:189-207) ------
+
+    def elastic(self) -> bool:
+        """True when the worker range is elastic (min < max)."""
+        return self.spec.worker.min_replicas < self.spec.worker.max_replicas
+
+    def need_tpu(self) -> bool:
+        """TPU analog of NeedGPU (reference: training_job.go:205-207)."""
+        return self.spec.worker.chips_per_worker > 0
+
+    def chips_per_worker(self) -> int:
+        return self.spec.worker.chips_per_worker
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TrainingJob":
+        """Build from a parsed YAML/JSON manifest mirroring the reference's
+        examplejob.yaml shape (reference: example/fit_a_line/examplejob.yaml)."""
+        meta = d.get("metadata", {})
+        spec_d = d.get("spec", {})
+        worker_d = spec_d.get("worker", spec_d.get("trainer", {})) or {}
+        pserver_d = spec_d.get("pserver", {}) or {}
+        master_d = spec_d.get("master", {}) or {}
+        mesh_d = spec_d.get("mesh", {}) or {}
+
+        def _minmax(g: dict, lo_default=0, hi_default=0):
+            lo = g.get("min_replicas", g.get("min-instance", lo_default))
+            hi = g.get("max_replicas", g.get("max-instance", hi_default))
+            return int(lo), int(hi)
+
+        wmin, wmax = _minmax(worker_d, 1, 0)
+        pmin, pmax = _minmax(pserver_d)
+        mesh_fields = {f for f in MeshSpec.__dataclass_fields__}
+        bad_axes = set(mesh_d) - mesh_fields
+        if bad_axes:
+            raise ValueError(
+                f"unknown mesh axes {sorted(bad_axes)}; valid: {sorted(mesh_fields)}"
+            )
+        try:
+            mesh = MeshSpec(**{k: int(v) for k, v in mesh_d.items()})
+        except (TypeError, ValueError) as e:
+            raise ValueError(f"invalid mesh spec {mesh_d!r}: {e}") from e
+        spec = TrainingJobSpec(
+            image=spec_d.get("image", ""),
+            host_network=bool(spec_d.get("host_network", False)),
+            port=int(spec_d.get("port", 0)),
+            ports_num=int(spec_d.get("ports_num", 0)),
+            fault_tolerant=bool(spec_d.get("fault_tolerant", False)),
+            passes=int(spec_d.get("passes", worker_d.get("passes", 0))),
+            accelerator_type=spec_d.get("accelerator_type", ""),
+            node_selector=dict(spec_d.get("node_selector", {})),
+            mesh=mesh,
+            master=MasterSpec(
+                coordinator_endpoint=master_d.get(
+                    "coordinator_endpoint", master_d.get("etcd-endpoint", "")
+                ),
+                resources=ResourceRequirements.parse(master_d.get("resources")),
+            ),
+            pserver=PserverSpec(
+                min_replicas=pmin,
+                max_replicas=pmax,
+                resources=ResourceRequirements.parse(pserver_d.get("resources")),
+            ),
+            worker=WorkerSpec(
+                entrypoint=worker_d.get("entrypoint", ""),
+                workspace=worker_d.get("workspace", ""),
+                min_replicas=wmin,
+                max_replicas=wmax,
+                resources=ResourceRequirements.parse(worker_d.get("resources")),
+            ),
+        )
+        return cls(
+            name=meta.get("name", d.get("name", "")),
+            namespace=meta.get("namespace", "default"),
+            labels=dict(meta.get("labels", {})),
+            spec=spec,
+        )
+
+    @classmethod
+    def from_yaml(cls, text: str) -> "TrainingJob":
+        if not _HAVE_YAML:  # pragma: no cover
+            raise RuntimeError("pyyaml unavailable")
+        return cls.from_dict(yaml.safe_load(text))
+
+    @classmethod
+    def from_yaml_file(cls, path: str) -> "TrainingJob":
+        with open(path) as f:
+            return cls.from_yaml(f.read())
+
+
+@dataclass
+class Event:
+    """Controller→autoscaler/updater event
+    (reference: pkg/autoscaler.go:141-152)."""
+
+    class Type(str, enum.Enum):
+        ADD = "add"
+        DEL = "del"
+        UPDATE = "update"
+        SCALE = "scale"
+
+    type: "Event.Type"
+    job: Optional[TrainingJob] = None
+
+
+__all__ = [
+    "API_VERSION",
+    "KIND",
+    "Event",
+    "JobPhase",
+    "MasterSpec",
+    "MeshSpec",
+    "PserverSpec",
+    "ResourceRequirements",
+    "ResourceState",
+    "ResourceStatus",
+    "ResourceSpec",
+    "TrainingJob",
+    "TrainingJobSpec",
+    "TrainingJobStatus",
+    "WorkerSpec",
+]

@@ -1,0 +1,190 @@
+"""JobParser — defaulting, validation, and TrainingJob → child-resource plans.
+
+L2 of the layer map. The reference parses a TrainingJob into a master
+ReplicaSet + pserver ReplicaSet + trainer batch Job
+(reference: pkg/jobparser.go:36-41,47-71; pkg/updater/jobparser.go:40-64).
+The TPU design parses into two plans:
+
+- ``CoordinatorPlan`` — one coordinator process (master analog; owns
+  membership, barriers, the elastic data queue, reshard signaling).
+- ``WorkerGroupPlan`` — the elastic worker set, parallelism starting at
+  ``min_replicas`` (reference: ParseToTrainer sets Parallelism=min,
+  jobparser.go:120-128).
+
+There is no pserver plan: parameter/optimizer state lives in-mesh.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+from edl_tpu.api.job import (
+    DEFAULT_ACCELERATOR,
+    DEFAULT_IMAGE,
+    DEFAULT_PASSES,
+    DEFAULT_PORT,
+    TrainingJob,
+)
+
+
+class ValidationError(ValueError):
+    pass
+
+
+@dataclass
+class CoordinatorPlan:
+    """Spec for the per-job coordinator process (replaces master RS +
+    etcd sidecar, reference: pkg/jobparser.go:186-227)."""
+
+    name: str
+    namespace: str
+    image: str
+    port: int
+    labels: Dict[str, str] = field(default_factory=dict)
+    cpu_milli: int = 0
+    mem_mega: int = 0
+
+
+@dataclass
+class WorkerGroupPlan:
+    """Spec for the elastic worker set (trainer batch Job analog,
+    reference: pkg/jobparser.go:119-165)."""
+
+    name: str
+    namespace: str
+    image: str
+    entrypoint: str
+    workspace: str
+    parallelism: int
+    min_replicas: int
+    max_replicas: int
+    chips_per_worker: int
+    accelerator_type: str
+    cpu_milli: int = 0
+    mem_mega: int = 0
+    fault_tolerant: bool = False
+    passes: int = 1
+    labels: Dict[str, str] = field(default_factory=dict)
+    env: Dict[str, str] = field(default_factory=dict)
+    restart_policy: str = "Never"  # reference: jobparser.go:160
+
+
+class JobParser:
+    """Default parser (reference: DefaultJobParser, pkg/jobparser.go:43)."""
+
+    def validate(self, job: TrainingJob) -> List[str]:
+        """Fill defaults, enforce invariants; returns non-fatal warnings.
+
+        Defaulting mirrors reference pkg/jobparser.go:47-65; the
+        ``elastic ⇒ fault_tolerant`` rule mirrors jobparser.go:66-68.
+        TPU additions: chips per worker must be a power of two (ICI
+        slice legality) and an accelerator type is defaulted.
+        """
+        warnings: List[str] = []
+        s = job.spec
+        if not job.name:
+            raise ValidationError("job name is required")
+        if s.port == 0:
+            s.port = DEFAULT_PORT
+        if s.ports_num == 0:
+            s.ports_num = 1
+        if not s.image:
+            s.image = DEFAULT_IMAGE
+        if s.passes == 0:
+            s.passes = DEFAULT_PASSES
+        if not s.accelerator_type:
+            s.accelerator_type = DEFAULT_ACCELERATOR
+        w = s.worker
+        if w.min_replicas <= 0:
+            w.min_replicas = 1
+        if w.max_replicas == 0:
+            w.max_replicas = w.min_replicas
+        if w.max_replicas < w.min_replicas:
+            raise ValidationError(
+                f"worker max_replicas ({w.max_replicas}) < min_replicas ({w.min_replicas})"
+            )
+        if job.elastic() and not s.fault_tolerant:
+            # reference: pkg/jobparser.go:66-68
+            raise ValidationError(
+                "max_replicas must equal min_replicas when fault_tolerant is disabled"
+            )
+        chips = w.chips_per_worker
+        if chips and chips & (chips - 1):
+            raise ValidationError(
+                f"tpu_chips per worker must be a power of two (got {chips})"
+            )
+        if s.pserver.min_replicas or s.pserver.max_replicas:
+            warnings.append(
+                "pserver group is ignored on TPU: parameter/optimizer state is "
+                "sharded in-mesh (FSDP); remove spec.pserver"
+            )
+        mesh_total = 1
+        for v in s.mesh.axis_sizes().values():
+            mesh_total *= v
+        if chips and mesh_total > 1 and mesh_total % chips != 0 and chips % mesh_total:
+            warnings.append(
+                f"mesh plan ({mesh_total} devices) does not tile chips/worker ({chips})"
+            )
+        return warnings
+
+    # -- plan builders -----------------------------------------------------
+
+    def parse_to_coordinator(self, job: TrainingJob) -> CoordinatorPlan:
+        """reference: ParseToMaster pkg/jobparser.go:186-227."""
+        s = job.spec
+        return CoordinatorPlan(
+            name=f"{job.name}-coordinator",
+            namespace=job.namespace,
+            image=s.image,
+            port=s.port,
+            labels={"edl-job-coordinator": job.name},
+            cpu_milli=s.master.resources.requests.cpu_milli,
+            mem_mega=s.master.resources.requests.mem_mega,
+        )
+
+    def parse_to_workers(self, job: TrainingJob) -> WorkerGroupPlan:
+        """reference: ParseToTrainer pkg/jobparser.go:119-165."""
+        s = job.spec
+        w = s.worker
+        return WorkerGroupPlan(
+            name=f"{job.name}-worker",
+            namespace=job.namespace,
+            image=s.image,
+            entrypoint=w.entrypoint,
+            workspace=w.workspace,
+            parallelism=w.min_replicas,
+            min_replicas=w.min_replicas,
+            max_replicas=w.max_replicas,
+            chips_per_worker=w.chips_per_worker,
+            accelerator_type=s.accelerator_type,
+            cpu_milli=w.resources.requests.cpu_milli,
+            mem_mega=w.resources.requests.mem_mega,
+            fault_tolerant=s.fault_tolerant,
+            passes=s.passes,
+            labels={"edl-job": job.name},
+            env=self.pod_env(job),
+        )
+
+    def pod_env(self, job: TrainingJob) -> Dict[str, str]:
+        """Env-var contract injected into every worker
+        (reference: podEnv pkg/jobparser.go:263-311). TPU renames:
+        EDL_* replaces PADDLE_INIT_*; the coordinator address replaces
+        etcd discovery."""
+        s = job.spec
+        return {
+            "EDL_JOB_NAME": job.name,
+            "EDL_NAMESPACE": job.namespace,
+            "EDL_WORKERS": str(s.worker.min_replicas),
+            "EDL_WORKERS_MIN": str(s.worker.min_replicas),
+            "EDL_WORKERS_MAX": str(s.worker.max_replicas),
+            "EDL_ENTRY": s.worker.entrypoint,
+            "EDL_WORKSPACE": s.worker.workspace,
+            "EDL_PORT": str(s.port),
+            "EDL_CHIPS_PER_WORKER": str(s.worker.chips_per_worker),
+            "EDL_ACCELERATOR": s.accelerator_type,
+            "EDL_NUM_PASSES": str(s.passes),
+            "EDL_FAULT_TOLERANT": "1" if s.fault_tolerant else "0",
+            "EDL_COORDINATOR": s.master.coordinator_endpoint
+            or f"{job.name}-coordinator:{s.port}",
+        }

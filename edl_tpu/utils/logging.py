@@ -1,0 +1,73 @@
+"""Structured key-value logging (log15 analog, reference: cmd/edl/edl.go:26-28).
+
+``kv_logger("autoscaler").info("scaling job", name=..., target=...)``
+renders ``msg key=value ...`` lines with a level gate, matching the
+reference's leveled KV style so operators get the same log surface.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+import time
+from typing import Any
+
+_FORMAT = "%(asctime)s %(levelname)-5s [%(name)s] %(message)s"
+_configured = False
+
+
+def configure(level: str = "info", stream=None) -> None:
+    """Install the root handler (reference flag: -log_level, cmd/edl/edl.go:18)."""
+    global _configured
+    root = logging.getLogger("edl_tpu")
+    root.setLevel(getattr(logging, level.upper(), logging.INFO))
+    if not _configured:
+        h = logging.StreamHandler(stream or sys.stderr)
+        h.setFormatter(logging.Formatter(_FORMAT))
+        root.addHandler(h)
+        root.propagate = False
+        _configured = True
+
+
+class KVLogger:
+    def __init__(self, name: str):
+        self._log = logging.getLogger(f"edl_tpu.{name}")
+
+    @staticmethod
+    def _render(msg: str, kv: dict) -> str:
+        if not kv:
+            return msg
+        parts = " ".join(f"{k}={v!r}" for k, v in kv.items())
+        return f"{msg} {parts}"
+
+    def debug(self, msg: str, **kv: Any) -> None:
+        self._log.debug(self._render(msg, kv))
+
+    def info(self, msg: str, **kv: Any) -> None:
+        self._log.info(self._render(msg, kv))
+
+    def warn(self, msg: str, **kv: Any) -> None:
+        self._log.warning(self._render(msg, kv))
+
+    def error(self, msg: str, **kv: Any) -> None:
+        self._log.error(self._render(msg, kv))
+
+
+def kv_logger(name: str) -> KVLogger:
+    return KVLogger(name)
+
+
+class Timer:
+    """Context-manager stopwatch for reshard-stall accounting (the
+    north-star metric; no reference analog — SURVEY §5 tracing gap)."""
+
+    def __init__(self):
+        self.elapsed = 0.0
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.elapsed = time.perf_counter() - self._t0
+        return False

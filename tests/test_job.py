@@ -1,0 +1,151 @@
+"""TrainingJob spec, quantities, defaulting, validation.
+
+Ports the reference predicate/unit tests
+(pkg/resource/training_job_test.go:27-46, pkg/utils_test.go:25-48,
+pkg/autoscaler_internal_test.go:96-101) onto the TPU resource model.
+"""
+
+import pytest
+
+from edl_tpu.api.job import JobPhase, TrainingJob
+from edl_tpu.api.parser import JobParser, ValidationError
+from edl_tpu.api.resources import (
+    ResourceSpec,
+    add_resource_list,
+    cpu_milli,
+    mem_mega,
+    parse_quantity,
+)
+
+EXAMPLE_YAML = """
+apiVersion: edl-tpu.org/v1
+kind: TrainingJob
+metadata:
+  name: example
+spec:
+  image: "edl-tpu/example"
+  port: 7164
+  fault_tolerant: true
+  accelerator_type: v5e
+  worker:
+    entrypoint: "python /workspace/train_ft.py"
+    workspace: "/workspace"
+    passes: 50
+    min_replicas: 2
+    max_replicas: 10
+    resources:
+      requests: {cpu: "200m", memory: "200Mi", tpu: 4}
+      limits: {cpu: "200m", memory: "200Mi", tpu: 4}
+  pserver:
+    min_replicas: 2
+    max_replicas: 2
+  master:
+    resources:
+      requests: {cpu: "500m", memory: "600Mi"}
+      limits: {cpu: "1", memory: "1Gi"}
+"""
+
+# The reference's legacy YAML keys (min-instance, trainer:) must also parse.
+LEGACY_YAML = """
+metadata: {name: legacy}
+spec:
+  fault_tolerant: true
+  trainer:
+    entrypoint: "python train.py"
+    min-instance: 2
+    max-instance: 6
+    resources:
+      requests: {cpu: "1", memory: "1Gi"}
+"""
+
+
+def test_quantity_parsing():
+    # reference: TestTrainerRequestLimit autoscaler_internal_test.go:96-101
+    assert cpu_milli("1k") == 1_000_000
+    assert mem_mega("100Mi") == 105
+    assert parse_quantity("10") == 10
+    assert cpu_milli("200m") == 200
+    assert cpu_milli("1") == 1000
+    assert mem_mega("1Gi") == 1074
+
+
+def test_resource_list_accumulate():
+    # reference: pkg/utils_test.go:25-48
+    dst = {"cpu": 1000.0, "memory": 100.0}
+    add_resource_list(dst, {"cpu": 500.0, "tpu": 4.0})
+    assert dst == {"cpu": 1500.0, "memory": 100.0, "tpu": 4.0}
+    a = ResourceSpec(1000, 100, 4) + ResourceSpec(200, 50, 4)
+    assert (a.cpu_milli, a.mem_mega, a.tpu_chips) == (1200, 150, 8)
+    assert ResourceSpec(100, 10, 1).scaled(3).tpu_chips == 3
+
+
+def test_from_yaml_and_predicates():
+    job = TrainingJob.from_yaml(EXAMPLE_YAML)
+    assert job.name == "example"
+    assert job.spec.worker.min_replicas == 2
+    assert job.spec.worker.max_replicas == 10
+    assert job.elastic()  # reference: training_job_test.go Elastic
+    assert job.need_tpu()  # reference: training_job_test.go NeedGPU
+    assert job.chips_per_worker() == 4
+    assert job.spec.worker.resources.requests.cpu_milli == 200
+    assert job.spec.master.resources.limits.mem_mega == 1074
+    assert job.status.phase == JobPhase.NONE
+
+
+def test_legacy_yaml_keys():
+    job = TrainingJob.from_yaml(LEGACY_YAML)
+    assert job.spec.worker.min_replicas == 2
+    assert job.spec.worker.max_replicas == 6
+    assert job.elastic()
+    assert not job.need_tpu()
+
+
+def test_validate_defaults():
+    # reference: Validate defaulting pkg/jobparser.go:47-65
+    job = TrainingJob.from_yaml(LEGACY_YAML)
+    warnings = JobParser().validate(job)
+    assert job.spec.port == 7164
+    assert job.spec.passes == 1
+    assert job.spec.image != ""
+    assert job.spec.accelerator_type == "v5e"
+    assert warnings == []
+
+
+def test_validate_elastic_requires_fault_tolerant():
+    # reference: pkg/jobparser.go:66-68
+    job = TrainingJob.from_yaml(EXAMPLE_YAML)
+    job.spec.fault_tolerant = False
+    with pytest.raises(ValidationError):
+        JobParser().validate(job)
+
+
+def test_validate_rejects_non_pow2_chips():
+    job = TrainingJob.from_yaml(EXAMPLE_YAML)
+    job.spec.worker.resources.limits.tpu_chips = 3
+    job.spec.worker.resources.requests.tpu_chips = 3
+    with pytest.raises(ValidationError):
+        JobParser().validate(job)
+
+
+def test_validate_warns_on_pserver():
+    job = TrainingJob.from_yaml(EXAMPLE_YAML)
+    warnings = JobParser().validate(job)
+    assert any("pserver" in w for w in warnings)
+
+
+def test_parse_plans():
+    job = TrainingJob.from_yaml(EXAMPLE_YAML)
+    p = JobParser()
+    p.validate(job)
+    coord = p.parse_to_coordinator(job)
+    workers = p.parse_to_workers(job)
+    # reference: ParseToTrainer Parallelism=min (jobparser.go:120-128)
+    assert workers.parallelism == 2
+    assert workers.chips_per_worker == 4
+    assert workers.restart_policy == "Never"  # reference: jobparser.go:160
+    assert coord.name == "example-coordinator"
+    env = workers.env
+    assert env["EDL_JOB_NAME"] == "example"
+    assert env["EDL_WORKERS_MAX"] == "10"
+    assert env["EDL_FAULT_TOLERANT"] == "1"
+    assert "example-coordinator" in env["EDL_COORDINATOR"]
