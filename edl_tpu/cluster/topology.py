@@ -1,0 +1,78 @@
+"""TPU slice topology — the accelerator-shape knowledge the scheduler needs.
+
+The reference bin-packs per-node CPU/mem/GPU (reference: pkg/cluster.go:32-61,
+pkg/autoscaler.go:191-199). On TPU the unit is a *chip* living on a host
+that belongs to a pod slice; multi-host jobs want ICI-contiguous worker
+counts. This module encodes chips-per-host per accelerator family and
+slice-shape legality policies.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, List
+
+
+@dataclass(frozen=True)
+class AcceleratorFamily:
+    """Static facts about one TPU generation."""
+
+    name: str
+    chips_per_host: int  # chips driven by one worker process/host VM
+    ici_degree: int  # ICI links per chip (torus dimensionality * 2)
+
+
+FAMILIES: Dict[str, AcceleratorFamily] = {
+    "v4": AcceleratorFamily("v4", 4, 6),
+    "v5e": AcceleratorFamily("v5e", 4, 4),
+    "v5p": AcceleratorFamily("v5p", 4, 6),
+    "v6e": AcceleratorFamily("v6e", 4, 4),
+    "cpu": AcceleratorFamily("cpu", 0, 0),  # host-only jobs (fit_a_line local)
+}
+
+
+def family(name: str) -> AcceleratorFamily:
+    try:
+        return FAMILIES[name]
+    except KeyError:
+        raise ValueError(f"unknown accelerator family {name!r}") from None
+
+
+# --- slice-shape legality policies ------------------------------------------
+#
+# The autoscaler proposes worker-count deltas of ±1 (reference:
+# pkg/autoscaler.go:201-291). A SlicePolicy decides whether a proposed
+# worker count is a legal slice shape; illegal counts are skipped over
+# in the direction of travel.
+
+SlicePolicy = Callable[[int], bool]
+
+
+def flexible(n: int) -> bool:
+    """Any worker count (DCN-connected hosts / multislice). Matches the
+    reference's unconstrained Parallelism."""
+    return n >= 0
+
+
+def pow2(n: int) -> bool:
+    """ICI-contiguous slices: worker counts restricted to powers of two
+    (v5e pod slices: 1,2,4,8,... hosts). Zero is not a slice shape."""
+    return n >= 1 and (n & (n - 1)) == 0
+
+
+POLICIES: Dict[str, SlicePolicy] = {"flexible": flexible, "pow2": pow2}
+
+
+def next_legal(n: int, direction: int, policy: SlicePolicy, lo: int, hi: int) -> int:
+    """Nearest legal count moving from ``n`` by ``direction`` (±1), clamped
+    to [lo, hi]. Returns ``n`` when no legal count exists in range."""
+    cur = n + direction
+    while lo <= cur <= hi:
+        if policy(cur):
+            return cur
+        cur += direction
+    return n
+
+
+def legal_counts(policy: SlicePolicy, lo: int, hi: int) -> List[int]:
+    return [n for n in range(lo, hi + 1) if policy(n)]

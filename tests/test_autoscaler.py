@@ -1,0 +1,480 @@
+"""Scheduler spec — the reference's autoscaler test suite, ported case by
+case (reference: pkg/autoscaler_internal_test.go:96-438) onto the TPU
+resource model: GPU limits become TPU chips, node idle maps gain free
+chips. Under the default flexible slice policy the algorithm must match
+the reference step for step. TPU-only additions (pow2 slice policy,
+chip-aware host search) are at the bottom.
+"""
+
+from edl_tpu.api.job import TrainingJob, TrainingJobSpec, WorkerSpec
+from edl_tpu.api.resources import ResourceRequirements, ResourceSpec
+from edl_tpu.cluster import topology
+from edl_tpu.cluster.base import WorkerGroup
+from edl_tpu.cluster.resource import ClusterResource, Hosts
+from edl_tpu.scheduler.autoscaler import (
+    JobState,
+    elastic,
+    needs_chips,
+    scale_all_jobs_dry_run,
+    scale_dry_run,
+    sorted_jobs,
+)
+
+
+def make_job(name, cpu_req, mem_req, chips, lo, hi, parallelism) -> JobState:
+    """reference: makeJob autoscaler_internal_test.go:56-94."""
+    res = ResourceRequirements(
+        requests=ResourceSpec(cpu_milli=cpu_req, mem_mega=mem_req, tpu_chips=chips),
+        limits=ResourceSpec(cpu_milli=cpu_req, mem_mega=mem_req, tpu_chips=chips),
+    )
+    job = TrainingJob(
+        name=name,
+        spec=TrainingJobSpec(
+            worker=WorkerSpec(min_replicas=lo, max_replicas=hi, resources=res)
+        ),
+    )
+    group = WorkerGroup(
+        name=f"{name}-worker", namespace="default", plan=None, parallelism=parallelism
+    )
+    return JobState(config=job, group=group)
+
+
+def all_idle_hosts() -> Hosts:
+    """reference: allIdleNodes autoscaler_internal_test.go:109-112."""
+    return Hosts(
+        cpu_idle_milli={"host0": 99999},
+        mem_free_mega={"host0": 99999},
+        chips_free={"host0": 99999},
+    )
+
+
+def test_trainer_request_limit():
+    # reference: TestTrainerRequestLimit :96-101 (quantity math is covered
+    # in test_job.py; here the JobState accessors)
+    j = make_job("name", 1_000_000, 105, 8, 1, 1, 1)
+    assert j.cpu_request_milli() == 1_000_000
+    assert j.mem_request_mega() == 105
+    assert j.chips_per_worker() == 8
+
+
+def test_scale_dry_run_satisfied():
+    # reference: TestScaleDryRunSatisfied :103-107
+    r = ClusterResource(cpu_total_milli=2000, mem_total_mega=1000)
+    j = make_job("name", 1000, 100, 0, 1, 2, 2)
+    assert scale_dry_run(r, j, 0, 1.0, False) == 0
+
+
+def test_scale_dry_run_more_cpu():
+    # reference: TestScaleDryRunMoreCPU :114-126
+    r = ClusterResource(
+        cpu_limit_milli=100,
+        cpu_request_milli=100,
+        cpu_total_milli=3000,
+        mem_request_mega=100,
+        mem_limit_mega=100,
+        mem_total_mega=1000,
+        hosts=all_idle_hosts(),
+    )
+    j = make_job("name", 1000, 100, 0, 1, 3, 1)
+    assert scale_dry_run(r, j, 0, 1.0, False) == 1
+
+
+def test_scale_dry_run_no_more_cpu():
+    # reference: TestScaleDryRunNoMoreCPU :128-141
+    r = ClusterResource(
+        cpu_limit_milli=1000,
+        cpu_request_milli=1000,
+        cpu_total_milli=1000,
+        mem_request_mega=100,
+        mem_limit_mega=100,
+        mem_total_mega=1000,
+        hosts=all_idle_hosts(),
+    )
+    j = make_job("name", 1000, 100, 0, 1, 3, 1)
+    assert scale_dry_run(r, j, 0, 1.0, False) == 0
+
+
+def test_scale_dry_run_more_chips():
+    # reference: TestScaleDryRunMoreGPU :143-159
+    r = ClusterResource(
+        cpu_total_milli=2000,
+        mem_request_mega=100,
+        mem_limit_mega=100,
+        mem_total_mega=1000,
+        chip_limit=0,
+        chip_request=0,
+        chip_total=10,
+        hosts=all_idle_hosts(),
+    )
+    j = make_job("name", 1000, 10, 1, 1, 3, 1)
+    assert scale_dry_run(r, j, 0, 1.0, False) == 1
+    # "should not scale up if the scale down parameter is true"
+    r2 = ClusterResource(
+        cpu_total_milli=2000,
+        mem_request_mega=100,
+        mem_limit_mega=100,
+        mem_total_mega=1000,
+        chip_total=10,
+        hosts=all_idle_hosts(),
+    )
+    assert scale_dry_run(r2, j, 0, 1.0, True) == 0
+
+
+def test_scale_dry_run_no_more_chips():
+    # reference: TestScaleDryRunNoMoreGPU :161-177
+    r = ClusterResource(
+        cpu_total_milli=2000,
+        mem_request_mega=100,
+        mem_limit_mega=100,
+        mem_total_mega=1000,
+        chip_limit=10,
+        chip_request=10,
+        chip_total=10,
+        hosts=all_idle_hosts(),
+    )
+    j = make_job("name", 1000, 10, 1, 1, 3, 1)
+    assert scale_dry_run(r, j, 0, 1.0, False) == 0
+
+
+def test_scale_down_more_than_expected():
+    # reference: TestScaleDryRunScaleDownMoreThanExpected :179-197
+    # parallelism 6 over max 3: -1 per step until planned == max.
+    r = ClusterResource(
+        cpu_limit_milli=1000,
+        cpu_request_milli=1000,
+        cpu_total_milli=1000,
+        mem_request_mega=1000,
+        mem_limit_mega=1000,
+        mem_total_mega=1000,
+        chip_limit=10,
+        chip_request=10,
+        chip_total=10,
+    )
+    j = make_job("name", 1000, 10, 0, 1, 3, 6)
+    assert scale_dry_run(r, j, 0, 1.0, True) == -1
+    assert scale_dry_run(r, j, -1, 1.0, True) == -1
+    assert scale_dry_run(r, j, -2, 1.0, True) == -1
+    assert scale_dry_run(r, j, -3, 1.0, True) == 0
+
+
+def test_scale_down_to_min():
+    # reference: TestScaleDryRunScaleDownToMin :199-217
+    # cluster CPU over target load: -1 until min.
+    r = ClusterResource(
+        cpu_limit_milli=5000,
+        cpu_request_milli=5000,
+        cpu_total_milli=3000,
+        mem_request_mega=1000,
+        mem_limit_mega=1000,
+        mem_total_mega=1000,
+        chip_limit=10,
+        chip_request=10,
+        chip_total=10,
+        hosts=all_idle_hosts(),
+    )
+    j = make_job("name", 1000, 10, 0, 1, 3, 3)
+    assert scale_dry_run(r, j, 0, 1.0, True) == -1
+    assert scale_dry_run(r, j, -1, 1.0, True) == -1
+    assert scale_dry_run(r, j, -2, 1.0, True) == 0
+
+
+def test_scale_down_full_cluster():
+    # reference: TestScaleDryRunScaleDownFullCluster :219-236
+    r = ClusterResource(
+        cpu_limit_milli=2000,
+        cpu_request_milli=2000,
+        cpu_total_milli=1000,
+        mem_request_mega=1000,
+        mem_limit_mega=1000,
+        mem_total_mega=1000,
+        chip_limit=10,
+        chip_request=10,
+        chip_total=10,
+        hosts=all_idle_hosts(),
+    )
+    j = make_job("name", 1000, 10, 0, 1, 3, 3)
+    assert scale_dry_run(r, j, 0, 1.0, True) == -1
+    # "should not scale down if the scale down parameter is false"
+    r2 = ClusterResource(
+        cpu_limit_milli=2000,
+        cpu_request_milli=2000,
+        cpu_total_milli=1000,
+        mem_request_mega=1000,
+        mem_limit_mega=1000,
+        mem_total_mega=1000,
+        chip_limit=10,
+        chip_request=10,
+        chip_total=10,
+        hosts=all_idle_hosts(),
+    )
+    assert scale_dry_run(r2, j, 0, 1.0, False) == 0
+
+
+def test_scale_dry_run_no_mem():
+    # reference: TestScaleDryRunNoMem :238-254
+    r = ClusterResource(
+        cpu_limit_milli=1000,
+        cpu_request_milli=1000,
+        cpu_total_milli=1000,
+        mem_request_mega=1000,
+        mem_limit_mega=1000,
+        mem_total_mega=1000,
+        chip_limit=10,
+        chip_request=10,
+        chip_total=10,
+        hosts=all_idle_hosts(),
+    )
+    j = make_job("name", 1000, 100, 0, 1, 3, 1)
+    assert scale_dry_run(r, j, 0, 1.0, False) == 0
+
+
+def test_scale_all_dry_run_no_mem():
+    # reference: TestScaleAllDryRunNoMem :256-269
+    r = ClusterResource(
+        cpu_total_milli=1000,
+        mem_request_mega=1000,
+        mem_limit_mega=1000,
+        mem_total_mega=1000,
+        chip_total=10,
+        hosts=all_idle_hosts(),
+    )
+    j = make_job("name", 1000, 1, 1, 1, 3, 1)
+    assert scale_all_jobs_dry_run([j], r, 1.0)["name"] == 0
+
+
+def test_scale_all_dry_run():
+    # reference: TestScaleAllDryRun :271-288 — scale 1 → 3 (+2)
+    r = ClusterResource(
+        cpu_limit_milli=1000,
+        cpu_request_milli=1000,
+        cpu_total_milli=4000,
+        mem_request_mega=100,
+        mem_limit_mega=100,
+        mem_total_mega=1000,
+        chip_limit=8,
+        chip_request=8,
+        chip_total=10,
+        hosts=all_idle_hosts(),
+    )
+    j = make_job("name", 1000, 100, 0, 1, 3, 1)
+    assert scale_all_jobs_dry_run([j], r, 1.0)["name"] == 2
+
+
+def test_scale_all_dry_run_not_full():
+    # reference: TestScaleAllDryRunNotFull :290-307 — maxLoad 0.8 caps at +1
+    r = ClusterResource(
+        cpu_limit_milli=1000,
+        cpu_request_milli=1000,
+        cpu_total_milli=3000,
+        mem_request_mega=100,
+        mem_limit_mega=100,
+        mem_total_mega=1000,
+        chip_total=10,
+        hosts=all_idle_hosts(),
+    )
+    j = make_job("name", 1000, 100, 0, 1, 3, 1)
+    assert scale_all_jobs_dry_run([j], r, 0.8)["name"] == 1
+
+
+def test_scale_all_dry_run_down_not_full():
+    # reference: TestScaleAllDryRunDownNotFull :309-326 — over 0.8 load → -1
+    r = ClusterResource(
+        cpu_limit_milli=3000,
+        cpu_request_milli=3000,
+        cpu_total_milli=3000,
+        mem_request_mega=100,
+        mem_limit_mega=100,
+        mem_total_mega=1000,
+        chip_total=10,
+        hosts=all_idle_hosts(),
+    )
+    j = make_job("name", 1000, 100, 0, 1, 3, 3)
+    assert scale_all_jobs_dry_run([j], r, 0.8)["name"] == -1
+
+
+def test_scale_all_dry_run_less_cpu():
+    # reference: TestScaleAllDryRunLessCPU :328-345 — CPU bounds at +1
+    r = ClusterResource(
+        cpu_limit_milli=2000,
+        cpu_request_milli=2000,
+        cpu_total_milli=3000,
+        mem_request_mega=100,
+        mem_limit_mega=100,
+        mem_total_mega=1000,
+        chip_limit=8,
+        chip_request=8,
+        chip_total=10,
+        hosts=all_idle_hosts(),
+    )
+    j = make_job("name", 1000, 1, 1, 1, 3, 1)
+    assert scale_all_jobs_dry_run([j], r, 1.0)["name"] == 1
+
+
+def test_scale_all_dry_run_less_chips():
+    # reference: TestScaleAllDryRunLessGPU :347-364 — chips bound at +1
+    r = ClusterResource(
+        cpu_limit_milli=990,
+        cpu_request_milli=990,
+        cpu_total_milli=2000,
+        mem_request_mega=100,
+        mem_limit_mega=100,
+        mem_total_mega=1000,
+        chip_limit=9,
+        chip_request=9,
+        chip_total=10,
+        hosts=all_idle_hosts(),
+    )
+    j = make_job("name", 1, 1, 1, 1, 3, 1)
+    assert scale_all_jobs_dry_run([j], r, 1.0)["name"] == 1
+
+
+def test_fulfillment():
+    # reference: TestFulfillment :366-375
+    assert make_job("n", 1, 1, 1, 1, 2, 2).fulfillment() == 1.0
+    assert make_job("n", 1, 1, 1, 1, 2, 1).fulfillment() == 0.0
+    assert make_job("n", 1, 1, 1, 1, 3, 2).fulfillment() == 0.5
+
+
+def test_sorted_jobs():
+    # reference: TestSortedJobs :377-398 (d filtered: not elastic)
+    js = [
+        make_job("a", 1, 1, 1, 1, 2, 2),
+        make_job("b", 1, 1, 1, 1, 20, 2),
+        make_job("c", 1, 1, 1, 1, 10, 2),
+        make_job("d", 1, 1, 1, 1, 1, 2),
+    ]
+    assert [j.config.name for j in sorted_jobs(js, elastic)] == ["b", "c", "a"]
+
+
+def test_sorted_jobs_chips_only():
+    # reference: TestSortedJobsGPUOnly :400-420
+    js = [
+        make_job("a", 1, 1, 1, 1, 2, 2),
+        make_job("b", 1, 1, 0, 1, 20, 2),
+        make_job("c", 1, 1, 0, 1, 10, 2),
+        make_job("d", 1, 1, 0, 1, 1, 2),
+    ]
+    assert [j.config.name for j in sorted_jobs(js, needs_chips)] == ["a"]
+
+
+def test_sorted_jobs_with_tie():
+    # reference: TestSortedJobsWithTie :422-438 — fulfillment ties broken by
+    # chips asc, then CPU request asc, then memory request asc.
+    js = [
+        make_job("a", 1, 1, 1, 1, 2, 1),
+        make_job("b", 1, 1, 0, 1, 2, 1),
+        make_job("c", 10, 1, 0, 1, 2, 1),
+        make_job("d", 1, 2, 0, 1, 2, 1),
+    ]
+    assert [j.config.name for j in sorted_jobs(js, elastic)] == ["b", "d", "c", "a"]
+
+
+# ---------------------------------------------------------------------------
+# TPU-only behavior (no reference analog)
+# ---------------------------------------------------------------------------
+
+
+def test_chip_aware_host_search():
+    # A host with CPU/mem room but no free chips must not accept a
+    # chip worker (the reference's searchAssignableNode is chip-blind).
+    r = ClusterResource(
+        cpu_total_milli=99999,
+        mem_total_mega=99999,
+        chip_total=8,
+        hosts=Hosts(
+            cpu_idle_milli={"h0": 99999},
+            mem_free_mega={"h0": 99999},
+            chips_free={"h0": 0},
+        ),
+    )
+    j = make_job("name", 1, 1, 4, 1, 3, 1)
+    assert scale_dry_run(r, j, 0, 1.0, False) == 0
+
+
+def test_pow2_slice_policy_up():
+    # pow2 policy: 2 → 4 is one step of +2, and the resource guard must
+    # cover the whole step.
+    r = ClusterResource(
+        cpu_total_milli=99999,
+        mem_total_mega=99999,
+        chip_total=16,
+        chip_limit=8,
+        hosts=all_idle_hosts(),
+    )
+    j = make_job("name", 1, 1, 4, 1, 8, 2)
+    assert scale_dry_run(r, j, 0, 1.0, False, policy=topology.pow2) == 2
+    # only 1 chip headroom: the +2 step (8 chips) must be refused entirely
+    r2 = ClusterResource(
+        cpu_total_milli=99999,
+        mem_total_mega=99999,
+        chip_total=9,
+        chip_limit=8,
+        hosts=all_idle_hosts(),
+    )
+    j2 = make_job("name", 1, 1, 4, 1, 8, 2)
+    assert scale_dry_run(r2, j2, 0, 1.0, False, policy=topology.pow2) == 0
+
+
+def test_pow2_slice_policy_down():
+    # Over target load, pow2 steps 4 → 2 (delta -2), not -1.
+    r = ClusterResource(
+        cpu_request_milli=5000,
+        cpu_total_milli=3000,
+        mem_total_mega=99999,
+        chip_total=32,
+        chip_limit=16,
+        hosts=all_idle_hosts(),
+    )
+    j = make_job("name", 1000, 1, 4, 1, 8, 4)
+    assert scale_dry_run(r, j, 0, 1.0, True, policy=topology.pow2) == -2
+
+
+def test_pow2_step_spreads_over_hosts():
+    # A +2 step of 4-chip workers on 4-chip hosts must claim TWO hosts,
+    # not double-charge one.
+    r = ClusterResource(
+        cpu_total_milli=32000,
+        mem_total_mega=64000,
+        chip_total=16,
+        chip_limit=8,
+        hosts=Hosts(
+            cpu_idle_milli={f"h{i}": 8000 for i in range(4)},
+            mem_free_mega={f"h{i}": 16000 for i in range(4)},
+            chips_free={"h0": 0, "h1": 0, "h2": 4, "h3": 4},
+        ),
+    )
+    j = make_job("name", 500, 100, 4, 1, 8, 2)
+    assert scale_dry_run(r, j, 0, 1.0, False, policy=topology.pow2) == 2
+    assert r.hosts.chips_free["h2"] == 0
+    assert r.hosts.chips_free["h3"] == 0
+    # same step with only ONE free host: refused entirely
+    r2 = ClusterResource(
+        cpu_total_milli=32000,
+        mem_total_mega=64000,
+        chip_total=16,
+        chip_limit=12,
+        hosts=Hosts(
+            cpu_idle_milli={f"h{i}": 8000 for i in range(4)},
+            mem_free_mega={f"h{i}": 16000 for i in range(4)},
+            chips_free={"h0": 0, "h1": 0, "h2": 0, "h3": 4},
+        ),
+    )
+    assert scale_dry_run(r2, j, 0, 1.0, False, policy=topology.pow2) == 0
+
+
+def test_over_max_lands_on_legal_count():
+    # pow2 with an illegal max (6): from 8, walk down past 6 to legal 4.
+    r = ClusterResource(cpu_total_milli=99999, mem_total_mega=99999, chip_total=99)
+    j = make_job("name", 1, 1, 0, 1, 6, 8)
+    d1 = scale_dry_run(r, j, 0, 1.0, True, policy=topology.pow2)
+    assert d1 == -1  # 8 -> 7, still above max
+    d2 = scale_dry_run(r, j, -1, 1.0, True, policy=topology.pow2)
+    assert d2 == -3  # 7 -> 4 (6 and 5 are illegal)
+    assert not topology.pow2(0)
+
+
+def test_next_legal():
+    assert topology.next_legal(2, 1, topology.pow2, 1, 8) == 4
+    assert topology.next_legal(4, -1, topology.pow2, 1, 8) == 2
+    assert topology.next_legal(8, 1, topology.pow2, 1, 8) == 8  # no legal above
+    assert topology.next_legal(3, 1, topology.flexible, 1, 8) == 4

@@ -1,0 +1,102 @@
+"""Autoscaler × FakeCluster integration: the elastic squeeze.
+
+Miniature of the reference's demo trace (reference: doc/boss_tutorial.md
+"Deploy Multiple Training Jobs": job example 10→3, example1 8→4,
+example2 0→4 as contention rises): an elastic job grows to fill the
+fleet, then gets squeezed down when a second job's pods pend.
+"""
+
+from edl_tpu.api.job import Event, TrainingJob
+from edl_tpu.api.parser import JobParser
+from edl_tpu.cluster.fake import FakeCluster, FakeHost
+from edl_tpu.scheduler.autoscaler import Autoscaler
+
+
+def make_job(name, lo, hi, chips=4):
+    job = TrainingJob.from_dict(
+        {
+            "metadata": {"name": name},
+            "spec": {
+                "fault_tolerant": True,
+                "worker": {
+                    "min_replicas": lo,
+                    "max_replicas": hi,
+                    "resources": {
+                        "requests": {"cpu": "500m", "memory": "1Gi", "tpu": chips},
+                        "limits": {"cpu": "500m", "memory": "1Gi", "tpu": chips},
+                    },
+                },
+            },
+        }
+    )
+    JobParser().validate(job)
+    return job
+
+
+def submit(cluster, asc, job):
+    cluster.submit_job(job)
+    cluster.create_worker_group(JobParser().parse_to_workers(job))
+    asc._update_job_list(Event(Event.Type.ADD, job))
+
+
+def test_grow_to_fill_then_squeeze():
+    cluster = FakeCluster(
+        hosts=[FakeHost(f"h{i}", 8000, 16000, 4) for i in range(4)]
+    )
+    asc = Autoscaler(cluster)
+
+    j1 = make_job("alpha", lo=2, hi=8)
+    submit(cluster, asc, j1)
+    asc.tick()
+    # 16 chips / 4 per worker: alpha grows to the whole fleet
+    assert cluster.get_worker_group(j1).parallelism == 4
+    assert cluster.job_pods(j1) == (4, 4, 0)
+
+    j2 = make_job("beta", lo=2, hi=8)
+    submit(cluster, asc, j2)
+    # beta's pods pend (no chips free) → alpha is squeezed to make room
+    asc.tick()
+    assert cluster.get_worker_group(j1).parallelism == 2
+    asc.tick()  # second tick: beta's pods are now placed
+    assert cluster.job_pods(j2) == (2, 2, 0)
+    r = cluster.inquiry_resource()
+    assert r.chip_limit == 16  # fleet saturated, nothing pending
+
+    # beta finishes → alpha grows back (elastic recovery)
+    cluster.delete_worker_group("default", "beta-worker")
+    cluster.delete_job("default", "beta")
+    asc._update_job_list(Event(Event.Type.DEL, j2))
+    asc.tick()
+    assert cluster.get_worker_group(j1).parallelism == 4
+
+
+def test_rescale_cooldown_damps_pingpong():
+    # With a cooldown, a freshly-rescaled job is left alone next tick
+    # (unless pods pend), so the fulfillment ping-pong cannot thrash.
+    cluster = FakeCluster(
+        hosts=[FakeHost(f"h{i}", 8000, 16000, 4) for i in range(4)]
+    )
+    asc = Autoscaler(cluster, rescale_cooldown_s=3600.0)
+    j1 = make_job("alpha", lo=2, hi=8)
+    submit(cluster, asc, j1)
+    asc.tick()
+    assert cluster.get_worker_group(j1).parallelism == 4
+    p = cluster.get_worker_group(j1).parallelism
+    for _ in range(3):
+        asc.tick()
+        assert cluster.get_worker_group(j1).parallelism == p
+    # a pending job overrides the cooldown (reference semantics: pending
+    # jobs may reschedule everything, pkg/autoscaler.go:487-511)
+    j2 = make_job("beta", lo=2, hi=8)
+    submit(cluster, asc, j2)
+    asc.tick()
+    assert cluster.get_worker_group(j1).parallelism == 2
+
+
+def test_non_elastic_job_untouched():
+    cluster = FakeCluster(hosts=[FakeHost("h0", 8000, 16000, 8)])
+    asc = Autoscaler(cluster)
+    j = make_job("fixed", lo=2, hi=2)
+    submit(cluster, asc, j)
+    asc.tick()
+    assert cluster.get_worker_group(j).parallelism == 2

@@ -1,0 +1,150 @@
+"""FakeCluster: census, placement, contention, conflicts, fault injection.
+
+Exercises the in-memory backend the way the reference's controller uses
+the real one (reference: pkg/cluster.go InquiryResource/JobPods/
+UpdateTrainerJob), plus the watch/store surface of the API-server
+stand-in.
+"""
+
+import pytest
+
+from edl_tpu.api.job import Event, TrainingJob
+from edl_tpu.api.parser import JobParser
+from edl_tpu.cluster.base import ConflictError
+from edl_tpu.cluster.fake import FakeCluster, FakeHost
+
+
+def tpu_fleet(n_hosts=4, chips=4, cpu=8000, mem=16000):
+    return FakeCluster(
+        hosts=[FakeHost(f"host{i}", cpu, mem, chips) for i in range(n_hosts)]
+    )
+
+
+def make_job(name="j1", lo=2, hi=8, chips=4, cpu="500m", mem="1Gi"):
+    job = TrainingJob.from_dict(
+        {
+            "metadata": {"name": name},
+            "spec": {
+                "fault_tolerant": True,
+                "worker": {
+                    "min_replicas": lo,
+                    "max_replicas": hi,
+                    "resources": {
+                        "requests": {"cpu": cpu, "memory": mem, "tpu": chips},
+                        "limits": {"cpu": cpu, "memory": mem, "tpu": chips},
+                    },
+                },
+            },
+        }
+    )
+    JobParser().validate(job)
+    return job
+
+
+def test_census_totals_and_idle():
+    c = tpu_fleet()
+    r = c.inquiry_resource()
+    assert r.chip_total == 16
+    assert r.cpu_total_milli == 32000
+    assert r.mem_total_mega == 64000
+    assert r.hosts.chips_free["host0"] == 4
+
+
+def test_create_workers_places_pods():
+    c = tpu_fleet()
+    job = make_job()
+    plan = JobParser().parse_to_workers(job)
+    g = c.create_worker_group(plan)
+    assert g.parallelism == 2
+    total, running, pending = c.job_pods(job)
+    assert (total, running, pending) == (2, 2, 0)
+    r = c.inquiry_resource()
+    assert r.chip_limit == 8  # 2 workers * 4 chips
+    assert r.cpu_request_milli == 1000
+
+
+def test_scale_up_and_down_reconciles():
+    c = tpu_fleet()
+    job = make_job()
+    c.create_worker_group(JobParser().parse_to_workers(job))
+    g = c.get_worker_group(job)
+    g.parallelism = 4
+    c.update_worker_group(g)
+    assert c.job_pods(job) == (4, 4, 0)
+    g = c.get_worker_group(job)
+    g.parallelism = 2
+    c.update_worker_group(g)
+    assert c.job_pods(job) == (2, 2, 0)
+    assert c.inquiry_resource().chip_limit == 8
+
+
+def test_pending_under_contention():
+    # 4 hosts x 4 chips; 8 workers need 32 chips — half must pend.
+    c = tpu_fleet()
+    job = make_job(lo=8, hi=8)
+    c.create_worker_group(JobParser().parse_to_workers(job))
+    total, running, pending = c.job_pods(job)
+    assert total == 8
+    assert running == 4
+    assert pending == 4
+    r = c.inquiry_resource()
+    # pending pods still count in requests (reference: InquiryResource
+    # lists phase ∉ {Succeeded,Failed}, pkg/cluster.go:202-210)
+    assert r.chip_limit == 32
+    # ...but only placed pods consume host idle capacity
+    assert sum(r.hosts.chips_free.values()) == 0
+
+
+def test_stale_update_conflicts():
+    c = tpu_fleet()
+    job = make_job()
+    c.create_worker_group(JobParser().parse_to_workers(job))
+    g1 = c.get_worker_group(job)
+    g2 = c.get_worker_group(job)
+    g1.parallelism = 3
+    c.update_worker_group(g1)
+    g2.parallelism = 5
+    with pytest.raises(ConflictError):
+        c.update_worker_group(g2)
+
+
+def test_watch_and_store():
+    c = tpu_fleet()
+    seen = []
+    c.watch_jobs(lambda ev: seen.append((ev.type, ev.job.name)))
+    job = make_job()
+    c.submit_job(job)
+    c.submit_job(job)
+    c.delete_job(job.namespace, job.name)
+    assert seen == [
+        (Event.Type.ADD, "j1"),
+        (Event.Type.UPDATE, "j1"),
+        (Event.Type.DEL, "j1"),
+    ]
+
+
+def test_kill_pod_and_external_contention():
+    c = tpu_fleet()
+    job = make_job()
+    c.create_worker_group(JobParser().parse_to_workers(job))
+    pods = [p for p in c.pods.values() if p.role == "worker"]
+    c.kill_pod(pods[0].name)
+    total, running, pending = c.job_pods(job)
+    assert running == 1
+    g = c.get_worker_group(job)
+    assert g.failed == 1
+    # nginx-filler analog eats host CPU (reference: example/fit_a_line/nginx.yaml)
+    c.add_external_pod("nginx-0", cpu_milli=7000, mem_mega=1000)
+    r = c.inquiry_resource()
+    assert r.cpu_request_milli >= 7000
+
+
+def test_coordinator_lifecycle():
+    c = tpu_fleet()
+    job = make_job()
+    plan = JobParser().parse_to_coordinator(job)
+    coord = c.create_coordinator(plan)
+    assert c.get_coordinator("default", coord.name).ready_replicas == 1
+    c.delete_coordinator("default", coord.name)
+    with pytest.raises(KeyError):
+        c.get_coordinator("default", coord.name)
