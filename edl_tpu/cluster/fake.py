@@ -166,13 +166,22 @@ class FakeCluster(Cluster):
             g = self.groups.get((namespace, name))
             if g is None:
                 raise KeyError(f"worker group {namespace}/{name} not found")
+            # active is computed live from pods (k8s Job .Status.Active
+            # analog); succeeded/failed are cumulative counters.
+            active = sum(
+                1
+                for p in self.pods.values()
+                if p.namespace == namespace
+                and self._group_name_of(p) == name
+                and p.phase == PodPhase.RUNNING
+            )
             return WorkerGroup(
                 name=g.name,
                 namespace=g.namespace,
                 plan=g.plan,
                 parallelism=g.parallelism,
                 resource_version=g.resource_version,
-                active=g.active,
+                active=active,
                 succeeded=g.succeeded,
                 failed=g.failed,
             )
@@ -322,6 +331,8 @@ class FakeCluster(Cluster):
         + scheduler, SURVEY §3.2/§3.3 'external')."""
         with self._lock:
             for (ns, gname), g in self.groups.items():
+                if g.succeeded > 0:
+                    continue  # completed groups are never resurrected
                 live = sorted(
                     (
                         p

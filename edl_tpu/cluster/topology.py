@@ -65,12 +65,28 @@ POLICIES: Dict[str, SlicePolicy] = {"flexible": flexible, "pow2": pow2}
 
 def next_legal(n: int, direction: int, policy: SlicePolicy, lo: int, hi: int) -> int:
     """Nearest legal count moving from ``n`` by ``direction`` (±1), clamped
-    to [lo, hi]. Returns ``n`` when no legal count exists in range."""
+    to [lo, hi]. A count outside the range jumps to the range edge first
+    (so a job below its min can climb into range). Returns ``n`` when no
+    legal count exists in range."""
     cur = n + direction
+    if direction > 0 and cur < lo:
+        cur = lo
+    if direction < 0 and cur > hi:
+        cur = hi
     while lo <= cur <= hi:
         if policy(cur):
             return cur
         cur += direction
+    return n
+
+
+def floor_legal(n: int, policy: SlicePolicy, lo: int, hi: int) -> int:
+    """Largest legal count ≤ min(n, hi) and ≥ lo; ``n`` if none exists."""
+    cur = min(n, hi)
+    while cur >= lo:
+        if policy(cur):
+            return cur
+        cur -= 1
     return n
 
 

@@ -90,13 +90,6 @@ def sorted_jobs(js: List[JobState], *filters: Callable[[JobState], bool]) -> Lis
     return out
 
 
-def search_assignable_host(r: ClusterResource, j: JobState) -> Optional[str]:
-    """First host with room for one more worker (reference:
-    searchAssignableNode pkg/autoscaler.go:191-199, + chip awareness)."""
-    hosts = search_assignable_hosts(r, j, 1)
-    return hosts[0] if hosts else None
-
-
 def search_assignable_hosts(
     r: ClusterResource, j: JobState, n: int
 ) -> Optional[List[str]]:
@@ -169,7 +162,7 @@ def scale_dry_run(
             # max, land on a policy-legal count.
             if planned - 1 > hi:
                 return _account(-1)
-            target = topology.next_legal(planned, -1, policy, lo, hi)
+            target = topology.floor_legal(planned - 1, policy, lo, hi)
             return _account(target - planned if target != planned else -1)
         chip_over = r.chip_limit > r.chip_total * max_load_desired
         cpu_over = r.cpu_request_milli > r.cpu_total_milli * max_load_desired
@@ -182,7 +175,9 @@ def scale_dry_run(
 
     # ---- scale-up pass (reference: pkg/autoscaler.go:252-291) ----
     if planned >= hi:
-        return _account(hi - planned)
+        # clamp back to max, landing on a policy-legal count
+        target = topology.floor_legal(planned, policy, lo, hi)
+        return _account(min(target, hi) - planned)
 
     target = topology.next_legal(planned, +1, policy, lo, hi)
     step = target - planned
@@ -343,9 +338,19 @@ class Autoscaler:
 
     # -- the scaling tick --------------------------------------------------
 
+    def drain_events(self) -> None:
+        """Fold queued job events into the tracked set
+        (reference: updateJobList on eventCh receipt :453-459)."""
+        while True:
+            try:
+                self._update_job_list(self._events.get_nowait())
+            except queue.Empty:
+                return
+
     def tick(self) -> Dict[str, int]:
         """One census→plan→apply cycle; returns the applied target map
         (reference: the loop body of Run, pkg/autoscaler.go:460-484)."""
+        self.drain_events()
         try:
             r = self.cluster.inquiry_resource()
         except Exception as e:  # reference: :461-465
@@ -374,7 +379,7 @@ class Autoscaler:
         target = {
             name: self.jobs[name].group.parallelism + d
             for name, d in diff.items()
-            if self.jobs.get(name) and self.jobs[name].group
+            if d != 0 and self.jobs.get(name) and self.jobs[name].group
         }
         if target:
             log.info("calculated scaling plan", target=target)
@@ -409,15 +414,7 @@ class Autoscaler:
         """reference: Run pkg/autoscaler.go:451-485."""
         while not self._stop.is_set():
             try:
-                ev = self._events.get(timeout=self.loop_seconds)
-                if not self._update_job_list(ev):
-                    continue
-                # drain any queued events before planning
-                while True:
-                    try:
-                        self._update_job_list(self._events.get_nowait())
-                    except queue.Empty:
-                        break
+                self._update_job_list(self._events.get(timeout=self.loop_seconds))
             except queue.Empty:
                 pass
             self.tick()

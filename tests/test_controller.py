@@ -1,0 +1,166 @@
+"""Controller + updater lifecycle against the FakeCluster.
+
+The integration tests the reference never wrote (SURVEY §4: the fake
+clientset was "never used" there); state-machine semantics follow
+pkg/updater/trainingJobUpdater.go.
+"""
+
+from edl_tpu.api.job import JobPhase, ResourceState, TrainingJob
+from edl_tpu.cluster.fake import FakeCluster, FakeHost
+from edl_tpu.controller.controller import Controller
+from edl_tpu.controller.updater import JobUpdater
+
+
+def tpu_fleet(n=4):
+    return FakeCluster(hosts=[FakeHost(f"h{i}", 8000, 16000, 4) for i in range(n)])
+
+
+def make_job(name="j1", lo=2, hi=8, ft=True, chips=4):
+    return TrainingJob.from_dict(
+        {
+            "metadata": {"name": name},
+            "spec": {
+                "fault_tolerant": ft,
+                "worker": {
+                    "min_replicas": lo,
+                    "max_replicas": hi,
+                    "resources": {
+                        "requests": {"cpu": "500m", "memory": "1Gi", "tpu": chips},
+                        "limits": {"tpu": chips},
+                    },
+                },
+            },
+        }
+    )
+
+
+def test_lifecycle_to_running():
+    c = tpu_fleet()
+    job = make_job()
+    u = JobUpdater(job, c)
+    assert u.phase == JobPhase.NONE
+    u.step()  # parse -> creating -> create coordinator (await ready)
+    # FakeCluster places the coordinator synchronously, so one more step
+    # creates workers and reaches running
+    u.step()
+    assert u.phase == JobPhase.RUNNING
+    assert job.status.master.state == ResourceState.READY
+    assert job.status.parallelism == 2
+    assert c.job_pods(job) == (2, 2, 0)
+
+
+def test_validation_failure_goes_failed():
+    c = tpu_fleet()
+    job = make_job(ft=False, lo=2, hi=8)  # elastic without fault_tolerant
+    u = JobUpdater(job, c)
+    u.step()
+    assert u.phase == JobPhase.FAILED
+    assert "fault_tolerant" in job.status.reason
+
+
+def test_ft_job_survives_partial_failure():
+    # reference: FT fails only when ALL workers failed (GetStatus :361-370)
+    c = tpu_fleet()
+    job = make_job()
+    u = JobUpdater(job, c)
+    u.step()
+    u.step()
+    pods = [p for p in c.pods.values() if p.role == "worker"]
+    c.kill_pod(pods[0].name)
+    u.step()
+    assert u.phase == JobPhase.RUNNING
+    c.kill_pod(pods[1].name)
+    u.step()
+    assert u.phase == JobPhase.FAILED
+    assert "all workers" in job.status.reason
+
+
+def test_ft_job_survives_replacement_churn():
+    # Cumulative failures must NOT fail a job whose replacements are
+    # healthy (the reference's GetStatus compares cumulative Failed ==
+    # Parallelism and would false-fail here).
+    c = tpu_fleet()
+    job = make_job()
+    u = JobUpdater(job, c)
+    u.step()
+    u.step()
+    for _ in range(3):  # kill -> replace -> kill the replacement ...
+        pods = [
+            p
+            for p in c.pods.values()
+            if p.role == "worker" and p.phase == "running"
+        ]
+        c.kill_pod(pods[0].name)
+        c.reconcile()  # k8s Job controller creates a replacement
+        u.step()
+        assert u.phase == JobPhase.RUNNING, job.status.reason
+
+
+def test_non_ft_job_fails_on_any_failure():
+    # reference: non-FT fails on ANY worker failure (GetStatus :371-380)
+    c = tpu_fleet()
+    job = make_job(ft=False, lo=2, hi=2)
+    u = JobUpdater(job, c)
+    u.step()
+    u.step()
+    pods = [p for p in c.pods.values() if p.role == "worker"]
+    c.kill_pod(pods[0].name)
+    u.step()
+    assert u.phase == JobPhase.FAILED
+
+
+def test_success_releases_coordinator():
+    c = tpu_fleet()
+    job = make_job()
+    u = JobUpdater(job, c)
+    u.step()
+    u.step()
+    c.finish_workers("default", "j1-worker", success=True)
+    u.step()
+    assert u.phase == JobPhase.SUCCEEDED
+    # terminal release: coordinator gone (reference: Convert :400-412)
+    assert ("default", "j1-coordinator") not in c.coordinators
+
+
+def test_controller_end_to_end_sync():
+    c = tpu_fleet()
+    ctl = Controller(c, max_load_desired=1.0)
+    job = make_job()
+    c.submit_job(job)  # watch fires on_add -> updater created
+    ctl.step()
+    assert ctl.phase_of("j1") == JobPhase.RUNNING
+    # autoscaler grows the job into the idle fleet
+    ctl.autoscaler.tick()
+    g = c.get_worker_group(job)
+    assert g.parallelism == 4
+    # scale event surfaced as SCALING phase, then runtime reports done
+    assert ctl.phase_of("j1") == JobPhase.SCALING
+    ctl.updaters["j1"].on_reshard_done(stall_s=1.5)
+    assert ctl.phase_of("j1") == JobPhase.RUNNING
+    assert job.status.reshard_count == 1
+    assert job.status.last_reshard_stall_s == 1.5
+    # deletion drains everything
+    c.delete_job("default", "j1")
+    assert "j1" not in ctl.updaters
+    assert ("default", "j1-worker") not in c.groups
+
+
+def test_controller_threaded_run():
+    c = tpu_fleet()
+    ctl = Controller(c, max_load_desired=1.0)
+    ctl.autoscaler.loop_seconds = 0.05
+    ctl.run(updater_interval_s=0.05)
+    job = make_job()
+    c.submit_job(job)
+    import time
+
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if (
+            ctl.phase_of("j1") in (JobPhase.RUNNING, JobPhase.SCALING)
+            and c.get_worker_group(job).parallelism == 4
+        ):
+            break
+        time.sleep(0.05)
+    ctl.stop()
+    assert c.get_worker_group(job).parallelism == 4
