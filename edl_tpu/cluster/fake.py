@@ -291,6 +291,21 @@ class FakeCluster(Cluster):
             self.pods[name] = pod
         self.reconcile()
 
+    def remove_host(self, name: str) -> None:
+        """Host failure: the host leaves the fleet and every pod on it
+        dies (the TPU-slice-preemption analog)."""
+        with self._lock:
+            if name not in self.hosts:
+                raise KeyError(name)
+            del self.hosts[name]
+            for p in self.pods.values():
+                if p.host == name and p.phase == PodPhase.RUNNING:
+                    p.phase = PodPhase.FAILED
+                    p.host = None
+                    g = self.groups.get((p.namespace, self._group_name_of(p)))
+                    if g is not None:
+                        g.failed += 1
+
     def kill_pod(self, name: str) -> None:
         """Fault injection: mark a pod failed and free its host."""
         with self._lock:
@@ -347,8 +362,13 @@ class FakeCluster(Cluster):
                 while len(live) > g.parallelism:
                     victim = live.pop()
                     self._release(self.pods.pop(victim.name))
-                # scale up: create pending pods at fresh indices
-                used = {p.index for p in live}
+                # scale up: create pending pods at fresh indices (terminated
+                # pods keep their records and names, like k8s)
+                used = {
+                    p.index
+                    for p in self.pods.values()
+                    if p.namespace == ns and self._group_name_of(p) == gname
+                }
                 idx = 0
                 while len(live) < g.parallelism:
                     while idx in used:
@@ -368,7 +388,13 @@ class FakeCluster(Cluster):
                     used.add(idx)
             for (ns, cname), c in self.coordinators.items():
                 pname = f"{ns}/{cname}-0"
-                if pname not in self.pods:
+                existing = self.pods.get(pname)
+                # a dead coordinator pod is replaced (ReplicaSet semantics),
+                # unlike terminated worker pods which keep their records
+                if existing is None or existing.phase in (
+                    PodPhase.FAILED,
+                    PodPhase.SUCCEEDED,
+                ):
                     self.pods[pname] = FakePod(
                         name=pname,
                         namespace=ns,

@@ -1,0 +1,48 @@
+"""fit_a_line — linear regression on the UCI-housing-shaped problem.
+
+Port of the reference's canonical workload
+(reference: example/fit_a_line/train_ft.py:40-118,
+ example/fit_a_line/train_local.py:41-106): a single dense layer
+regressing 13 features to 1 target under squared error. Synthetic data
+generation replaces the imikolov/uci RecordIO shards baked into the
+example image (reference: example/fit_a_line/Dockerfile:1-8).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N_FEATURES = 13  # uci_housing feature width (reference: train_ft.py:44)
+
+
+def init_params(key: jax.Array) -> Dict[str, jnp.ndarray]:
+    wk, _ = jax.random.split(key)
+    return {
+        "w": jax.random.normal(wk, (N_FEATURES, 1), jnp.float32) * 0.01,
+        "b": jnp.zeros((1,), jnp.float32),
+    }
+
+
+def predict(params, x: jnp.ndarray) -> jnp.ndarray:
+    return x @ params["w"] + params["b"]
+
+
+def loss_fn(params, batch) -> jnp.ndarray:
+    """Mean squared error (reference: square_error_cost, train_ft.py:93)."""
+    pred = predict(params, batch["x"])
+    return jnp.mean((pred - batch["y"]) ** 2)
+
+
+def synthetic_dataset(
+    n: int, seed: int = 0, noise: float = 0.1
+) -> Tuple[np.ndarray, np.ndarray]:
+    """A fixed random linear problem so loss-goes-down is testable."""
+    rng = np.random.RandomState(seed)
+    w_true = rng.randn(N_FEATURES, 1).astype(np.float32)
+    x = rng.randn(n, N_FEATURES).astype(np.float32)
+    y = x @ w_true + noise * rng.randn(n, 1).astype(np.float32)
+    return x, y

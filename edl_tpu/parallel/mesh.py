@@ -1,0 +1,124 @@
+"""Device mesh construction — the parallelism axes of the framework.
+
+The reference's only parallelism is pserver data-parallelism over TCP
+(SURVEY §2.5); here every strategy is a first-class mesh axis over
+ICI/DCN, consumed by ``jax.jit`` shardings, ``shard_map`` collectives,
+or both:
+
+    dp    pure data parallel (params replicated, grads all-reduced)
+    pp    pipeline stages (ppermute neighbor transfer)
+    fsdp  fully-sharded data parallel (ZeRO-3: params/grads/opt sharded)
+    sp    sequence/context parallel (ring attention)
+    ep    expert parallel (MoE all-to-all)
+    tp    tensor parallel (innermost: highest-bandwidth ICI)
+
+Axis order is fixed outermost→innermost so that tp lands on the
+fastest ICI neighbors and dp/pp can cross DCN (the scaling-book
+layout recipe).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from edl_tpu.api.job import MeshSpec
+
+AXIS_ORDER: Tuple[str, ...] = ("dp", "pp", "fsdp", "sp", "ep", "tp")
+
+# Axes over which a batch is split (each shard sees different examples).
+BATCH_AXES: Tuple[str, ...] = ("dp", "fsdp")
+
+
+@dataclass(frozen=True)
+class MeshPlan:
+    """A named-axis factorization of a device count."""
+
+    axes: Tuple[Tuple[str, int], ...]  # ordered (name, size), all sizes >= 1
+
+    @classmethod
+    def create(cls, **sizes: int) -> "MeshPlan":
+        bad = set(sizes) - set(AXIS_ORDER)
+        if bad:
+            raise ValueError(f"unknown mesh axes {sorted(bad)}")
+        axes = tuple((a, int(sizes.get(a, 1))) for a in AXIS_ORDER if sizes.get(a, 1) > 1)
+        return cls(axes=axes if axes else (("dp", 1),))
+
+    @classmethod
+    def from_spec(cls, spec: MeshSpec, n_devices: int) -> "MeshPlan":
+        """Complete a user MeshSpec against an actual device count: the
+        given axes must divide ``n_devices``; the remainder goes to dp
+        (elastic growth lands on the data axis)."""
+        sizes = spec.axis_sizes()
+        prod = math.prod(sizes.values()) if sizes else 1
+        if n_devices % prod:
+            raise ValueError(
+                f"mesh axes {sizes} (={prod}) do not divide {n_devices} devices"
+            )
+        rest = n_devices // prod
+        sizes["dp"] = sizes.get("dp", 1) * rest
+        return cls.create(**sizes)
+
+    @classmethod
+    def data_parallel(cls, n_devices: int) -> "MeshPlan":
+        return cls.create(dp=n_devices)
+
+    @classmethod
+    def fsdp_only(cls, n_devices: int) -> "MeshPlan":
+        return cls.create(fsdp=n_devices)
+
+    @property
+    def names(self) -> Tuple[str, ...]:
+        return tuple(a for a, _ in self.axes)
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(s for _, s in self.axes)
+
+    def size(self) -> int:
+        return math.prod(self.shape)
+
+    def axis_size(self, name: str) -> int:
+        for a, s in self.axes:
+            if a == name:
+                return s
+        return 1
+
+    def batch_axes(self) -> Tuple[str, ...]:
+        return tuple(a for a in self.names if a in BATCH_AXES)
+
+    def batch_shards(self) -> int:
+        return math.prod(self.axis_size(a) for a in self.batch_axes()) or 1
+
+    # -- construction ------------------------------------------------------
+
+    def build(self, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+        """Materialize a ``jax.sharding.Mesh``. Devices default to all
+        local devices; an elastic reshard passes the surviving subset."""
+        devs = list(devices) if devices is not None else list(jax.devices())
+        n = self.size()
+        if len(devs) < n:
+            raise ValueError(f"mesh needs {n} devices, have {len(devs)}")
+        arr = np.array(devs[:n]).reshape(self.shape)
+        return Mesh(arr, self.names)
+
+    # -- shardings ---------------------------------------------------------
+
+    def batch_pspec(self) -> P:
+        """Batch dimension split over every batch axis, rest replicated."""
+        ba = self.batch_axes()
+        return P(ba if ba else None)
+
+    def batch_sharding(self, mesh: Mesh) -> NamedSharding:
+        return NamedSharding(mesh, self.batch_pspec())
+
+    def replicated(self, mesh: Mesh) -> NamedSharding:
+        return NamedSharding(mesh, P())
+
+    def describe(self) -> Dict[str, int]:
+        return dict(self.axes)

@@ -1,0 +1,70 @@
+"""Parameter/activation sharding rules.
+
+Replaces the reference's pserver parameter blocks (parameters split
+round-robin across pserver processes, SURVEY §2.5 "proto-TP") with
+XLA-native named shardings: each array gets a PartitionSpec derived
+from the mesh plan, XLA inserts the collectives. FSDP here is the
+ZeRO-3 analog the reference lacks (required for the Llama elastic-FSDP
+baseline config).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from edl_tpu.parallel.mesh import MeshPlan
+
+
+def fsdp_pspec(shape, fsdp_size: int, tp_size: int = 1, axis: str = "fsdp") -> P:
+    """ZeRO-3 placement for one param: shard the largest dimension
+    divisible by the fsdp axis; replicate if nothing divides (small
+    params — biases, norm scales — stay replicated, which is what
+    you want on TPU: no gather traffic for tiny arrays)."""
+    if fsdp_size <= 1 or not shape:
+        return P()
+    order = sorted(range(len(shape)), key=lambda i: -shape[i])
+    for i in order:
+        if shape[i] % fsdp_size == 0:
+            spec: list = [None] * len(shape)
+            spec[i] = axis
+            return P(*spec)
+    return P()
+
+
+def param_pspecs(params, plan: MeshPlan) -> Any:
+    """Pytree of PartitionSpecs for a param tree: fsdp sharding when the
+    plan has an fsdp axis, else fully replicated (dp). Models with tensor
+    parallelism provide their own specs instead (see models/llama.py)."""
+    fsdp = plan.axis_size("fsdp")
+    return jax.tree_util.tree_map(
+        lambda p: fsdp_pspec(getattr(p, "shape", ()), fsdp), params
+    )
+
+
+def named(tree, mesh: Mesh):
+    """PartitionSpec tree → NamedSharding tree."""
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s),
+        tree,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def shard_tree(tree, mesh: Mesh, pspecs) -> Any:
+    """Place a host/device pytree onto the mesh with the given specs
+    (the reshard primitive: jax.device_put with NamedSharding moves or
+    re-slices as needed)."""
+    shardings = named(pspecs, mesh)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, s), tree, shardings
+    )
+
+
+def to_host(tree) -> Any:
+    """Fetch a (possibly sharded) pytree fully to host memory — the
+    checkpoint-in-RAM half of the reshard protocol."""
+    return jax.tree_util.tree_map(lambda x: np.asarray(jax.device_get(x)), tree)

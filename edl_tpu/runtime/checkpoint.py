@@ -1,0 +1,105 @@
+"""Checkpointing — the reshard mechanism, and disk persistence.
+
+The reference delegates persistence to workload code
+(reference: example/ctr/ctr/train.py:169-180 save_inference_model every
+1000 batches) and pserver state to Paddle's etcd runtime. Here
+checkpointing is first-class (SURVEY §5: "it is the reshard mechanism"):
+
+- ``snapshot``/``restore``: device state ⇄ host RAM — the fast path an
+  elastic rescale rides (no disk in the loop).
+- ``save``/``load``: host snapshot ⇄ disk, flattened-keypath npz — the
+  crash-recovery path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Any, Dict, Tuple
+
+import jax
+import numpy as np
+
+from edl_tpu.parallel import sharding as shd
+from edl_tpu.parallel.mesh import MeshPlan
+from edl_tpu.train.trainer import TrainState, shard_state
+
+
+def snapshot(state: TrainState) -> TrainState:
+    """Device → host RAM (step one of the reshard protocol)."""
+    return TrainState(
+        step=np.asarray(jax.device_get(state.step)),
+        params=shd.to_host(state.params),
+        opt_state=shd.to_host(state.opt_state),
+    )
+
+
+def restore(
+    host_state: TrainState, plan: MeshPlan, mesh, param_pspecs=None
+) -> TrainState:
+    """Host RAM → device, sharded for the (possibly new) mesh (step
+    three of the reshard protocol)."""
+    return shard_state(host_state, plan, mesh, param_pspecs)
+
+
+# -- disk format -------------------------------------------------------------
+
+
+def _flatten(tree) -> Dict[str, np.ndarray]:
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        key = "/".join(jax.tree_util.keystr((p,)).strip("[]'.") for p in path)
+        flat[key] = np.asarray(leaf)
+    return flat
+
+
+def save(path: str, state: TrainState, metadata: Dict[str, Any] = None) -> None:
+    """Atomic npz checkpoint: params + opt_state + step (+ JSON sidecar)."""
+    os.makedirs(path, exist_ok=True)
+    host = snapshot(state) if not isinstance(state.step, np.ndarray) else state
+    payload = {"step": np.asarray(host.step)}
+    payload.update({f"p:{k}": v for k, v in _flatten(host.params).items()})
+    payload.update({f"o:{k}": v for k, v in _flatten(host.opt_state).items()})
+    fd, tmp = tempfile.mkstemp(dir=path, suffix=".npz.tmp")
+    os.close(fd)
+    with open(tmp, "wb") as f:
+        np.savez(f, **payload)
+    os.replace(tmp, os.path.join(path, "state.npz"))
+    with open(os.path.join(path, "meta.json"), "w") as f:
+        json.dump(metadata or {}, f)
+
+
+def load(path: str, like: TrainState) -> TrainState:
+    """Load into the structure of ``like`` (a template state — freshly
+    initialized params/opt_state define the tree)."""
+    with np.load(os.path.join(path, "state.npz")) as z:
+        data = {k: z[k] for k in z.files}
+
+    def _fill(tree, prefix):
+        flat_keys = _flatten(tree).keys()
+        leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(tree)
+        new_leaves = []
+        for path_entries, leaf in leaves_with_path:
+            key = "/".join(
+                jax.tree_util.keystr((p,)).strip("[]'.") for p in path_entries
+            )
+            stored = data[f"{prefix}:{key}"]
+            if stored.shape != np.shape(leaf):
+                raise ValueError(
+                    f"checkpoint shape mismatch at {key}: "
+                    f"{stored.shape} vs {np.shape(leaf)}"
+                )
+            new_leaves.append(stored)
+        return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+    return TrainState(
+        step=data["step"],
+        params=_fill(like.params, "p"),
+        opt_state=_fill(like.opt_state, "o"),
+    )
+
+
+def load_metadata(path: str) -> Dict[str, Any]:
+    with open(os.path.join(path, "meta.json")) as f:
+        return json.load(f)

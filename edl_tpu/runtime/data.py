@@ -1,0 +1,166 @@
+"""ElasticDataQueue — task-queue data dispatch that survives membership change.
+
+Port of the Paddle master's task queue semantics the reference leans on
+for elasticity (reference: docker/paddle_k8s:26-32 runs the master with
+``-chunk-per-task=1 -task-timout-dur=16s``; trainers pull tasks via
+``cloud_reader``, example/fit_a_line/train_ft.py:105-114): data is cut
+into chunk tasks; workers lease tasks; a lease that times out or whose
+worker leaves is redelivered, so sample coverage is exactly-once-ish
+across membership change. Passes (epochs) mirror the reference's
+``passes`` spec field.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+DEFAULT_LEASE_TIMEOUT_S = 16.0  # reference: -task-timout-dur=16s
+MAX_TASK_FAILURES = 3  # reference master's task failure cap analog
+
+
+@dataclass
+class Task:
+    """One chunk of work: a half-open range [start, end) of sample
+    indices (the RecordIO-chunk analog)."""
+
+    task_id: int
+    start: int
+    end: int
+    epoch: int
+    failures: int = 0
+
+
+@dataclass
+class _Lease:
+    task: Task
+    worker: str
+    expires: float
+
+
+class ElasticDataQueue:
+    """Thread-safe lease/ack task queue over ``n_samples`` split into
+    ``chunk_size`` tasks, replayed for ``passes`` epochs."""
+
+    def __init__(
+        self,
+        n_samples: int,
+        chunk_size: int,
+        passes: int = 1,
+        lease_timeout_s: float = DEFAULT_LEASE_TIMEOUT_S,
+    ):
+        if n_samples <= 0 or chunk_size <= 0:
+            raise ValueError("n_samples and chunk_size must be positive")
+        self.n_samples = n_samples
+        self.chunk_size = chunk_size
+        self.passes = passes
+        self.lease_timeout_s = lease_timeout_s
+        self._lock = threading.Lock()
+        self._epoch = 0
+        self._todo: List[Task] = []
+        self._leases: Dict[int, _Lease] = {}
+        self._done_count = 0
+        self._dead: List[Task] = []  # tasks that exceeded MAX_TASK_FAILURES
+        self._next_id = 0
+        self._fill_epoch(0)
+
+    def _fill_epoch(self, epoch: int) -> None:
+        for start in range(0, self.n_samples, self.chunk_size):
+            self._todo.append(
+                Task(
+                    task_id=self._next_id,
+                    start=start,
+                    end=min(start + self.chunk_size, self.n_samples),
+                    epoch=epoch,
+                )
+            )
+            self._next_id += 1
+
+    @property
+    def tasks_per_epoch(self) -> int:
+        return -(-self.n_samples // self.chunk_size)
+
+    # -- worker surface ----------------------------------------------------
+
+    def get_task(self, worker: str) -> Optional[Task]:
+        """Lease the next task (reference: cloud_reader's master fetch).
+        None when the epoch's tasks are all leased/done — the caller
+        retries or finishes."""
+        with self._lock:
+            self._reap_expired()
+            if not self._todo and not self._leases and self._advance_epoch():
+                pass
+            if not self._todo:
+                return None
+            task = self._todo.pop(0)
+            self._leases[task.task_id] = _Lease(
+                task=task, worker=worker, expires=time.monotonic() + self.lease_timeout_s
+            )
+            return task
+
+    def ack(self, task_id: int) -> None:
+        """Mark a leased task complete."""
+        with self._lock:
+            lease = self._leases.pop(task_id, None)
+            if lease is not None:
+                self._done_count += 1
+                if not self._todo and not self._leases:
+                    self._advance_epoch()
+
+    def nack(self, task_id: int) -> None:
+        """Return a task to the queue (worker failed mid-chunk)."""
+        with self._lock:
+            lease = self._leases.pop(task_id, None)
+            if lease is not None:
+                self._requeue(lease.task)
+
+    def release_worker(self, worker: str) -> int:
+        """Requeue every task leased by a departed worker (membership
+        change; reference: master redelivers on trainer death). Returns
+        the number requeued."""
+        with self._lock:
+            gone = [tid for tid, l in self._leases.items() if l.worker == worker]
+            for tid in gone:
+                self._requeue(self._leases.pop(tid).task)
+            return len(gone)
+
+    # -- state -------------------------------------------------------------
+
+    def done(self) -> bool:
+        with self._lock:
+            self._reap_expired()
+            return not self._todo and not self._leases and self._epoch >= self.passes - 1
+
+    def progress(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "epoch": self._epoch,
+                "todo": len(self._todo),
+                "leased": len(self._leases),
+                "done": self._done_count,
+                "dead": len(self._dead),
+            }
+
+    # -- internals (lock held) ---------------------------------------------
+
+    def _requeue(self, task: Task) -> None:
+        task.failures += 1
+        if task.failures > MAX_TASK_FAILURES:
+            self._dead.append(task)
+        else:
+            self._todo.append(task)
+
+    def _reap_expired(self) -> None:
+        now = time.monotonic()
+        expired = [tid for tid, l in self._leases.items() if l.expires <= now]
+        for tid in expired:
+            self._requeue(self._leases.pop(tid).task)
+
+    def _advance_epoch(self) -> bool:
+        if self._epoch < self.passes - 1:
+            self._epoch += 1
+            self._fill_epoch(self._epoch)
+            return True
+        return False

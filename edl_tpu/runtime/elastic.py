@@ -1,0 +1,233 @@
+"""ElasticTrainer — in-place mesh reshard instead of job restarts.
+
+The genuinely new part of the framework (SURVEY §7 layer 4). The
+reference achieves elasticity by killing/adding k8s pods and letting
+Paddle's etcd runtime re-form (reference: pkg/autoscaler.go:361
+retargets Parallelism; docker/paddle_k8s re-runs discovery). On TPU a
+restart throws away compiled programs and device state, so the protocol
+is instead:
+
+    scale event → snapshot state to host RAM → rebuild the mesh over the
+    new device set → re-shard state onto it → resume at the next step
+
+The north-star metric (BASELINE.md) is the stall this costs: target
+<30 s per reshard, zero restarts. The trainer times every reshard and
+reports it via callback (feeding TrainingJobStatus.last_reshard_stall_s).
+
+In-process, the device pool is the local ``jax.devices()`` list (tests:
+8 virtual CPU devices). Multi-host, the same protocol runs with
+``jax.distributed`` re-initialization between snapshot and rebuild —
+the coordinator owns membership epochs (runtime/coordinator.py).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+import optax
+
+from edl_tpu.api.job import MeshSpec
+from edl_tpu.parallel.mesh import MeshPlan
+from edl_tpu.runtime import checkpoint as ckpt
+from edl_tpu.train.trainer import TrainState, global_batch, make_train_step, shard_state
+from edl_tpu.utils.logging import Timer, kv_logger
+
+log = kv_logger("elastic")
+
+
+@dataclass
+class ReshardEvent:
+    """One elastic rescale, as observed by the runtime."""
+
+    from_workers: int
+    to_workers: int
+    stall_s: float  # snapshot + remesh + reshard (the traffic-stopping window)
+    recompile_s: float  # first-step compile on the new mesh (overlappable)
+    step: int
+
+
+@dataclass
+class TrainReport:
+    steps: int = 0
+    examples: int = 0
+    losses: List[float] = field(default_factory=list)
+    reshards: List[ReshardEvent] = field(default_factory=list)
+    train_seconds: float = 0.0
+
+    @property
+    def examples_per_sec(self) -> float:
+        return self.examples / self.train_seconds if self.train_seconds else 0.0
+
+
+class ElasticTrainer:
+    """Runs a sharded training loop that can rescale between steps.
+
+    Parameters
+    ----------
+    loss_fn : ``f(params, batch) -> scalar``
+    tx : optax optimizer
+    mesh_spec : user parallelism plan; remaining device factor goes to dp
+    chips_per_worker : devices driven by each worker (host) process
+    per_chip_batch : per-device batch size — global batch scales with the
+        worker count, the reference's elastic-DP throughput semantics
+    param_pspecs : optional model-provided PartitionSpec tree (TP models)
+    devices : device pool override (defaults to ``jax.devices()``)
+    """
+
+    def __init__(
+        self,
+        loss_fn: Callable,
+        tx: optax.GradientTransformation,
+        mesh_spec: Optional[MeshSpec] = None,
+        chips_per_worker: int = 1,
+        per_chip_batch: int = 32,
+        param_pspecs=None,
+        devices: Optional[Sequence[jax.Device]] = None,
+        on_reshard: Optional[Callable[[ReshardEvent], None]] = None,
+    ):
+        self.loss_fn = loss_fn
+        self.tx = tx
+        self.mesh_spec = mesh_spec or MeshSpec()
+        self.chips_per_worker = chips_per_worker
+        self.per_chip_batch = per_chip_batch
+        self.param_pspecs = param_pspecs
+        self.pool = list(devices) if devices is not None else list(jax.devices())
+        self.on_reshard = on_reshard
+
+        self.n_workers = 0
+        self.mesh = None
+        self.plan: Optional[MeshPlan] = None
+        self.state: Optional[TrainState] = None
+        self._step_fn = None
+        self._scale_target: Optional[int] = None
+        self.report = TrainReport()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @property
+    def n_devices(self) -> int:
+        return self.n_workers * self.chips_per_worker
+
+    @property
+    def global_batch_size(self) -> int:
+        return self.per_chip_batch * self.n_devices
+
+    def start(self, params, n_workers: int) -> None:
+        """Initial mesh + state placement + step compile."""
+        self._build(n_workers)
+        host = TrainState.create(params, self.tx)
+        self.state = shard_state(host, self.plan, self.mesh, self.param_pspecs)
+        log.info(
+            "elastic trainer started",
+            workers=n_workers,
+            devices=self.n_devices,
+            mesh=self.plan.describe(),
+        )
+
+    def _build(self, n_workers: int) -> None:
+        n_dev = n_workers * self.chips_per_worker
+        if n_dev > len(self.pool):
+            raise ValueError(
+                f"{n_workers} workers x {self.chips_per_worker} chips "
+                f"exceed device pool ({len(self.pool)})"
+            )
+        self.plan = MeshPlan.from_spec(self.mesh_spec, n_dev)
+        self.mesh = self.plan.build(self.pool[:n_dev])
+        self.n_workers = n_workers
+        self._step_fn = make_train_step(
+            self.loss_fn, self.tx, self.plan, self.mesh, self.param_pspecs
+        )
+
+    # -- elastic surface ---------------------------------------------------
+
+    def request_rescale(self, n_workers: int) -> None:
+        """Signal from the control plane (autoscaler retarget); honored
+        at the next step boundary — training never tears down."""
+        if n_workers != self.n_workers:
+            self._scale_target = n_workers
+
+    def _feasible(self, n_workers: int) -> bool:
+        n_dev = n_workers * self.chips_per_worker
+        if n_workers < 1 or n_dev > len(self.pool):
+            return False
+        try:
+            MeshPlan.from_spec(self.mesh_spec, n_dev)
+        except ValueError:
+            return False
+        return True
+
+    def _resolve_target(self, target: int) -> Optional[int]:
+        """Largest feasible worker count ≤ target (a retarget must never
+        crash the loop — an infeasible count degrades to the nearest
+        mesh-divisible one below it, or is ignored)."""
+        for n in range(min(target, len(self.pool) // max(self.chips_per_worker, 1)), 0, -1):
+            if self._feasible(n):
+                return n
+        return None
+
+    def _maybe_rescale(self) -> None:
+        target = self._scale_target
+        if target is None:
+            return
+        self._scale_target = None
+        target = self._resolve_target(target)
+        if target is None or target == self.n_workers:
+            if target is None:
+                log.warn("ignoring infeasible rescale target")
+            return
+        prev = self.n_workers
+        log.info("reshard begin", from_workers=prev, to_workers=target)
+        with Timer() as stall:
+            host = ckpt.snapshot(self.state)  # device -> host RAM
+            self._build(target)  # new mesh over new device set
+            self.state = ckpt.restore(  # host RAM -> new sharding
+                host, self.plan, self.mesh, self.param_pspecs
+            )
+        ev = ReshardEvent(
+            from_workers=prev,
+            to_workers=target,
+            stall_s=stall.elapsed,
+            recompile_s=0.0,  # filled after the first step on the new mesh
+            step=int(np.asarray(host.step)),
+        )
+        self.report.reshards.append(ev)
+        log.info(
+            "reshard done",
+            from_workers=prev,
+            to_workers=target,
+            stall_s=round(stall.elapsed, 4),
+        )
+        if self.on_reshard:
+            self.on_reshard(ev)
+
+    # -- training loop -----------------------------------------------------
+
+    def train_steps(self, data_fn: Callable[[int], Any], n_steps: int) -> TrainReport:
+        """Run ``n_steps`` updates; ``data_fn(global_batch_size)`` yields a
+        host batch each step (task-queue readers plug in here)."""
+        t0 = time.perf_counter()
+        raw_losses = []  # device arrays; materialized once after the loop
+        for _ in range(n_steps):
+            self._maybe_rescale()
+            batch = data_fn(self.global_batch_size)
+            dev_batch = global_batch(batch, self.plan, self.mesh)
+            first_on_mesh = (
+                bool(self.report.reshards)
+                and self.report.reshards[-1].recompile_s == 0.0
+            )
+            tc = time.perf_counter()
+            self.state, metrics = self._step_fn(self.state, dev_batch)
+            if first_on_mesh:
+                jax.block_until_ready(metrics["loss"])
+                self.report.reshards[-1].recompile_s = time.perf_counter() - tc
+            self.report.steps += 1
+            self.report.examples += self.global_batch_size
+            raw_losses.append(metrics["loss"])
+        jax.block_until_ready(self.state.params)
+        self.report.train_seconds += time.perf_counter() - t0
+        self.report.losses.extend(float(x) for x in raw_losses)
+        return self.report

@@ -1,0 +1,123 @@
+"""LocalJobRunner — the minimum end-to-end slice, in one process.
+
+Binds the control plane (controller/updater/autoscaler over a cluster
+backend) to the elastic runtime (mesh + reshard) for a single
+TrainingJob, playing the role of the reference's pod entrypoint + Paddle
+runtime (reference: docker/paddle_k8s start_new_trainer:121-143 exec'ing
+the user program against the master/etcd services). Scale retargets from
+the autoscaler flow straight into an in-place reshard; reshard stalls
+flow back into TrainingJobStatus.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import optax
+
+from edl_tpu.api.job import JobPhase, TrainingJob
+from edl_tpu.controller.controller import Controller
+from edl_tpu.runtime.data import ElasticDataQueue
+from edl_tpu.runtime.elastic import ElasticTrainer, ReshardEvent, TrainReport
+from edl_tpu.utils.logging import kv_logger
+
+log = kv_logger("localrun")
+
+
+class LocalJobRunner:
+    """Drive one submitted TrainingJob's training loop in-process."""
+
+    def __init__(
+        self,
+        controller: Controller,
+        job: TrainingJob,
+        loss_fn: Callable,
+        tx: optax.GradientTransformation,
+        init_params: Any,
+        chips_per_worker: Optional[int] = None,
+        per_chip_batch: int = 32,
+        param_pspecs=None,
+        devices=None,
+    ):
+        self.controller = controller
+        self.job = job
+        cluster = controller.cluster
+        group = cluster.get_worker_group(job)
+        self.trainer = ElasticTrainer(
+            loss_fn,
+            tx,
+            mesh_spec=job.spec.mesh,
+            chips_per_worker=chips_per_worker
+            if chips_per_worker is not None
+            else max(job.chips_per_worker(), 1),
+            per_chip_batch=per_chip_batch,
+            param_pspecs=param_pspecs,
+            devices=devices,
+            on_reshard=self._reshard_done,
+        )
+        # autoscaler retarget -> in-place reshard at next step boundary
+        self._attached = False
+        if hasattr(cluster, "scale_listeners"):
+            cluster.scale_listeners.append(self._on_scale)
+            self._attached = True
+        self.trainer.start(init_params, n_workers=group.parallelism)
+
+    def detach(self) -> None:
+        """Stop receiving scale events (called when the run completes, so
+        a finished runner is neither retargeted nor kept alive)."""
+        if self._attached:
+            try:
+                self.controller.cluster.scale_listeners.remove(self._on_scale)
+            except ValueError:
+                pass
+            self._attached = False
+
+    def _on_scale(self, job_name: str, parallelism: int) -> None:
+        if job_name == self.job.name:
+            self.trainer.request_rescale(parallelism)
+
+    def _reshard_done(self, ev: ReshardEvent) -> None:
+        u = self.controller.updaters.get(self.job.name)
+        if u is not None:
+            u.on_reshard_done(ev.stall_s)
+
+    def sync_membership(self) -> None:
+        """Reshard down to the live worker count when members die without
+        a retarget (failure detection; the coordinator-heartbeat analog of
+        Paddle's etcd membership — reference: train_ft.py:105-114
+        use_etcd=True). The scheduler's target may still include a
+        pending replacement; training proceeds with who's alive."""
+        try:
+            g = self.controller.cluster.get_worker_group(self.job)
+        except KeyError:
+            return
+        live = g.active
+        if 0 < live != self.trainer.n_workers:
+            log.info(
+                "membership change", live=live, workers=self.trainer.n_workers
+            )
+            self.trainer.request_rescale(live)
+
+    def run(
+        self,
+        data_fn: Callable[[int], Any],
+        n_steps: Optional[int] = None,
+        queue: Optional[ElasticDataQueue] = None,
+    ) -> TrainReport:
+        """Train until ``n_steps`` or (with a queue) until the data queue
+        drains; then mark the worker group complete so the updater's
+        convert() lands the job in SUCCEEDED."""
+        if n_steps is not None:
+            report = self.trainer.train_steps(data_fn, n_steps)
+        else:
+            assert queue is not None, "need n_steps or a queue"
+            report = self.trainer.report
+            while not queue.done():
+                self.sync_membership()
+                report = self.trainer.train_steps(data_fn, 1)
+        cluster = self.controller.cluster
+        if hasattr(cluster, "finish_workers"):
+            cluster.finish_workers(self.job.namespace, f"{self.job.name}-worker")
+        self.controller.step()
+        self.detach()
+        return report

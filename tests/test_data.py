@@ -1,0 +1,72 @@
+"""ElasticDataQueue: lease/ack, timeout redelivery, membership release,
+multi-pass (reference semantics: master task queue,
+docker/paddle_k8s:28-31 -chunk-per-task=1 -task-timout-dur=16s)."""
+
+import time
+
+from edl_tpu.runtime.data import ElasticDataQueue
+
+
+def test_lease_ack_drains():
+    q = ElasticDataQueue(n_samples=100, chunk_size=10, passes=1)
+    seen = []
+    while True:
+        t = q.get_task("w0")
+        if t is None:
+            break
+        seen.append((t.start, t.end))
+        q.ack(t.task_id)
+    assert len(seen) == 10
+    assert q.done()
+    # full coverage, no overlap
+    covered = sorted(seen)
+    assert covered[0] == (0, 10) and covered[-1] == (90, 100)
+
+
+def test_release_worker_redelivers():
+    q = ElasticDataQueue(n_samples=30, chunk_size=10, passes=1)
+    t0 = q.get_task("w0")
+    t1 = q.get_task("w1")
+    assert t0 and t1
+    n = q.release_worker("w0")  # w0 dies mid-chunk
+    assert n == 1
+    # w1 finishes everything, including the redelivered chunk
+    q.ack(t1.task_id)
+    got = []
+    while (t := q.get_task("w1")) is not None:
+        got.append(t.start)
+        q.ack(t.task_id)
+    assert t0.start in got
+    assert q.done()
+
+
+def test_lease_timeout_redelivers():
+    q = ElasticDataQueue(n_samples=20, chunk_size=10, passes=1, lease_timeout_s=0.05)
+    t0 = q.get_task("w0")
+    t1 = q.get_task("w0")
+    assert q.get_task("w0") is None  # all leased
+    time.sleep(0.08)  # both leases expire
+    t0b = q.get_task("w1")
+    assert t0b is not None and t0b.failures == 1
+    assert not q.done()
+
+
+def test_passes_replay():
+    q = ElasticDataQueue(n_samples=20, chunk_size=10, passes=3)
+    count = 0
+    while (t := q.get_task("w")) is not None:
+        count += 1
+        q.ack(t.task_id)
+    assert count == 6  # 2 chunks x 3 passes
+    assert q.done()
+
+
+def test_poison_task_dies_after_max_failures():
+    q = ElasticDataQueue(n_samples=10, chunk_size=10, passes=1, lease_timeout_s=0.01)
+    for _ in range(10):  # lease, let it expire, repeat past MAX_TASK_FAILURES
+        t = q.get_task("w")
+        if t is None:
+            break
+        time.sleep(0.02)
+    assert q.progress()["dead"] == 1
+    assert q.done() or q.progress()["todo"] == 0
