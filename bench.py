@@ -1,0 +1,103 @@
+"""Benchmark — CTR elastic-DP throughput + reshard stall on real hardware.
+
+The BASELINE metric (BASELINE.json): examples/sec/chip on the CTR
+workload plus rescale-stall seconds. On the single bench chip we
+measure per-chip training throughput of the Criteo-shaped CTR model
+(the reference's production workload, example/ctr/ctr/train.py) and the
+single-chip component of a reshard (device→host snapshot + host→device
+re-placement of the full train state — the traffic-stopping window of
+the elastic protocol).
+
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
+
+vs_baseline is 1.0: the reference publishes no throughput numbers
+(BASELINE.json "published": {}), so this bench line is the baseline
+being established for later rounds.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from edl_tpu.models import ctr
+from edl_tpu.parallel.mesh import MeshPlan
+from edl_tpu.runtime import checkpoint as ckpt
+from edl_tpu.train.trainer import TrainState, global_batch, make_train_step, shard_state
+
+BATCH = 16384
+WARMUP = 5
+MEASURE = 30
+
+
+def main() -> None:
+    n_dev = len(jax.devices())
+    plan = MeshPlan.data_parallel(n_dev)
+    mesh = plan.build()
+
+    params = ctr.init_params(jax.random.PRNGKey(0))  # full-size: 2^20 vocab
+    tx = optax.adam(1e-3)
+    state = shard_state(TrainState.create(params, tx), plan, mesh)
+    step = make_train_step(ctr.make_loss_fn(jnp.bfloat16), tx, plan, mesh)
+
+    rng = np.random.RandomState(0)
+    batches = [
+        global_batch(ctr.synthetic_batch(rng, BATCH), plan, mesh) for _ in range(4)
+    ]
+
+    # NOTE: on tunneled backends block_until_ready can return before the
+    # device work completes; a scalar value fetch is the reliable fence.
+    t_compile = time.perf_counter()
+    for i in range(WARMUP):
+        state, m = step(state, batches[i % len(batches)])
+    float(m["loss"])
+    compile_s = time.perf_counter() - t_compile
+
+    t0 = time.perf_counter()
+    for i in range(MEASURE):
+        state, m = step(state, batches[i % len(batches)])
+    float(m["loss"])  # scalar fetch fences the whole dependent chain
+    dt = time.perf_counter() - t0
+    eps_per_chip = BATCH * MEASURE / dt / n_dev
+
+    # reshard stall, both protocol paths on this chip:
+    # fast path — direct device-to-device re-placement (what an elastic
+    # rescale uses when device sets overlap; rides ICI on multi-chip)
+    from edl_tpu.runtime.elastic import _device_reshard
+
+    t1 = time.perf_counter()
+    state2 = _device_reshard(state, plan, mesh, None)
+    float(jnp.sum(state2.params["out"]["b"]))
+    stall_fast_s = time.perf_counter() - t1
+    # fallback path — full host-RAM staging (worst case: disjoint devices)
+    t2 = time.perf_counter()
+    host = ckpt.snapshot(state2)
+    state3 = ckpt.restore(host, plan, mesh)
+    float(jnp.sum(state3.params["out"]["b"]))
+    stall_host_s = time.perf_counter() - t2
+
+    print(
+        json.dumps(
+            {
+                "metric": "ctr_examples_per_sec_per_chip",
+                "value": round(eps_per_chip, 1),
+                "unit": "examples/s/chip",
+                "vs_baseline": 1.0,
+                "reshard_stall_s": round(stall_fast_s, 4),
+                "reshard_stall_host_fallback_s": round(stall_host_s, 4),
+                "compile_s": round(compile_s, 2),
+                "final_loss": round(float(m["loss"]), 4),
+                "n_devices": n_dev,
+                "platform": jax.devices()[0].platform,
+                "global_batch": BATCH,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -75,6 +75,31 @@ def loss_fn(params, batch) -> jnp.ndarray:
     )
 
 
+def make_loss_fn(compute_dtype=jnp.float32):
+    """Loss with a cast-to-``compute_dtype`` forward (bfloat16 feeds the
+    MXU at full rate; params/optimizer stay float32). Loss is always
+    accumulated in float32."""
+
+    def _loss(params, batch):
+        if compute_dtype != jnp.float32:
+            # every float leaf, biases included — one f32 leaf in a
+            # bias-add would promote the whole activation back to f32
+            params = jax.tree_util.tree_map(
+                lambda x: x.astype(compute_dtype), params
+            )
+        logits = forward(
+            params, batch["dense"].astype(compute_dtype), batch["sparse"]
+        ).astype(jnp.float32)
+        labels = batch["label"].astype(jnp.float32)
+        return jnp.mean(
+            jnp.maximum(logits, 0)
+            - logits * labels
+            + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+        )
+
+    return _loss
+
+
 def batch_auc(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
     """Batch AUC via the rank statistic (reference tracks batch_auc_var,
     train.py:120-176)."""

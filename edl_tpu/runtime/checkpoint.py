@@ -46,19 +46,31 @@ def restore(
 # -- disk format -------------------------------------------------------------
 
 
-def _flatten(tree) -> Dict[str, np.ndarray]:
-    flat = {}
+def _leaf_keys(tree):
+    """[(key, leaf)] with stable string keys — the single source of the
+    key-derivation rule for both save and load."""
+    out = []
     for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
         key = "/".join(jax.tree_util.keystr((p,)).strip("[]'.") for p in path)
-        flat[key] = np.asarray(leaf)
-    return flat
+        out.append((key, leaf))
+    return out
+
+
+def _flatten(tree) -> Dict[str, np.ndarray]:
+    return {k: np.asarray(v) for k, v in _leaf_keys(tree)}
 
 
 def save(path: str, state: TrainState, metadata: Dict[str, Any] = None) -> None:
-    """Atomic npz checkpoint: params + opt_state + step (+ JSON sidecar)."""
+    """Atomic npz checkpoint: params + opt_state + step + metadata in ONE
+    file, published by a single rename (no torn meta/state pair)."""
     os.makedirs(path, exist_ok=True)
     host = snapshot(state) if not isinstance(state.step, np.ndarray) else state
-    payload = {"step": np.asarray(host.step)}
+    payload = {
+        "step": np.asarray(host.step),
+        "meta": np.frombuffer(
+            json.dumps(metadata or {}).encode(), dtype=np.uint8
+        ),
+    }
     payload.update({f"p:{k}": v for k, v in _flatten(host.params).items()})
     payload.update({f"o:{k}": v for k, v in _flatten(host.opt_state).items()})
     fd, tmp = tempfile.mkstemp(dir=path, suffix=".npz.tmp")
@@ -66,8 +78,6 @@ def save(path: str, state: TrainState, metadata: Dict[str, Any] = None) -> None:
     with open(tmp, "wb") as f:
         np.savez(f, **payload)
     os.replace(tmp, os.path.join(path, "state.npz"))
-    with open(os.path.join(path, "meta.json"), "w") as f:
-        json.dump(metadata or {}, f)
 
 
 def load(path: str, like: TrainState) -> TrainState:
@@ -77,13 +87,9 @@ def load(path: str, like: TrainState) -> TrainState:
         data = {k: z[k] for k in z.files}
 
     def _fill(tree, prefix):
-        flat_keys = _flatten(tree).keys()
-        leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(tree)
+        treedef = jax.tree_util.tree_structure(tree)
         new_leaves = []
-        for path_entries, leaf in leaves_with_path:
-            key = "/".join(
-                jax.tree_util.keystr((p,)).strip("[]'.") for p in path_entries
-            )
+        for key, leaf in _leaf_keys(tree):
             stored = data[f"{prefix}:{key}"]
             if stored.shape != np.shape(leaf):
                 raise ValueError(
@@ -101,5 +107,5 @@ def load(path: str, like: TrainState) -> TrainState:
 
 
 def load_metadata(path: str) -> Dict[str, Any]:
-    with open(os.path.join(path, "meta.json")) as f:
-        return json.load(f)
+    with np.load(os.path.join(path, "state.npz")) as z:
+        return json.loads(bytes(z["meta"]).decode())

@@ -90,8 +90,8 @@ class ElasticDataQueue:
         retries or finishes."""
         with self._lock:
             self._reap_expired()
-            if not self._todo and not self._leases and self._advance_epoch():
-                pass
+            if not self._todo and not self._leases:
+                self._advance_epoch()
             if not self._todo:
                 return None
             task = self._todo.pop(0)
@@ -164,3 +164,58 @@ class ElasticDataQueue:
             self._fill_epoch(self._epoch)
             return True
         return False
+
+
+class QueueBatcher:
+    """Fixed-size batches from chunked tasks, with correct at-least-once
+    accounting: a task is acked only when every one of its samples has
+    been handed out, so batch size and chunk size need not align (the
+    cloud_reader's buffered-read analog,
+    reference: example/fit_a_line/train_ft.py:111-114).
+
+    ``fetch(task) -> dict[str, np.ndarray]`` loads one chunk's arrays.
+    """
+
+    def __init__(self, queue: ElasticDataQueue, fetch, worker: str = "w0"):
+        self.queue = queue
+        self.fetch = fetch
+        self.worker = worker
+        self._buffer: List = []  # (task_id, arrays, offset)
+
+    def _buffered(self) -> int:
+        total = 0
+        for _, arrays, offset in self._buffer:
+            total += next(iter(arrays.values())).shape[0] - offset
+        return total
+
+    def next_batch(self, batch_size: int):
+        """Next batch dict, or None when the queue is drained. The final
+        batch may be short (callers pad or drop)."""
+        import numpy as _np
+
+        while self._buffered() < batch_size:
+            task = self.queue.get_task(self.worker)
+            if task is None:
+                break
+            self._buffer.append((task.task_id, self.fetch(task), 0))
+        if not self._buffer:
+            return None
+        need = batch_size
+        pieces: List = []
+        new_buffer = []
+        for task_id, arrays, offset in self._buffer:
+            n = next(iter(arrays.values())).shape[0]
+            if need > 0:
+                take = min(need, n - offset)
+                pieces.append({k: v[offset : offset + take] for k, v in arrays.items()})
+                offset += take
+                need -= take
+            if offset >= n:
+                self.queue.ack(task_id)  # fully consumed
+            else:
+                new_buffer.append((task_id, arrays, offset))
+        self._buffer = new_buffer
+        return {
+            k: _np.concatenate([p[k] for p in pieces], axis=0)
+            for k in pieces[0]
+        }

@@ -39,6 +39,33 @@ from edl_tpu.utils.logging import Timer, kv_logger
 log = kv_logger("elastic")
 
 
+def _device_reshard(state: TrainState, plan: MeshPlan, mesh, pspecs) -> TrainState:
+    """Move a live device-resident TrainState onto a (different) mesh by
+    direct ``jax.device_put`` — XLA routes shard movement device-to-device
+    where device sets overlap, which is the elastic fast path."""
+    from edl_tpu.train.trainer import state_pspecs as _sp
+    from edl_tpu.parallel import sharding as shd
+
+    sp = _sp(state, plan, pspecs)
+    new_state = TrainState(
+        step=jax.device_put(
+            jax.device_get(state.step), plan.replicated(mesh)
+        ),
+        params=jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, s),
+            state.params,
+            shd.named(sp.params, mesh),
+        ),
+        opt_state=jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, s),
+            state.opt_state,
+            shd.named(sp.opt_state, mesh),
+        ),
+    )
+    jax.block_until_ready(new_state.params)
+    return new_state
+
+
 @dataclass
 class ReshardEvent:
     """One elastic rescale, as observed by the runtime."""
@@ -74,7 +101,9 @@ class ElasticTrainer:
     chips_per_worker : devices driven by each worker (host) process
     per_chip_batch : per-device batch size — global batch scales with the
         worker count, the reference's elastic-DP throughput semantics
-    param_pspecs : optional model-provided PartitionSpec tree (TP models)
+    param_pspecs : optional model-provided PartitionSpec tree, or a
+        callable ``plan -> tree`` re-evaluated at every (re)build so TP
+        layouts track the current mesh plan
     devices : device pool override (defaults to ``jax.devices()``)
     """
 
@@ -95,6 +124,7 @@ class ElasticTrainer:
         self.chips_per_worker = chips_per_worker
         self.per_chip_batch = per_chip_batch
         self.param_pspecs = param_pspecs
+        self._pspecs = None  # resolved per-plan in _build
         self.pool = list(devices) if devices is not None else list(jax.devices())
         self.on_reshard = on_reshard
 
@@ -120,7 +150,7 @@ class ElasticTrainer:
         """Initial mesh + state placement + step compile."""
         self._build(n_workers)
         host = TrainState.create(params, self.tx)
-        self.state = shard_state(host, self.plan, self.mesh, self.param_pspecs)
+        self.state = shard_state(host, self.plan, self.mesh, self._pspecs)
         log.info(
             "elastic trainer started",
             workers=n_workers,
@@ -138,8 +168,13 @@ class ElasticTrainer:
         self.plan = MeshPlan.from_spec(self.mesh_spec, n_dev)
         self.mesh = self.plan.build(self.pool[:n_dev])
         self.n_workers = n_workers
+        self._pspecs = (
+            self.param_pspecs(self.plan)
+            if callable(self.param_pspecs)
+            else self.param_pspecs
+        )
         self._step_fn = make_train_step(
-            self.loss_fn, self.tx, self.plan, self.mesh, self.param_pspecs
+            self.loss_fn, self.tx, self.plan, self.mesh, self._pspecs
         )
 
     # -- elastic surface ---------------------------------------------------
@@ -180,19 +215,28 @@ class ElasticTrainer:
                 log.warn("ignoring infeasible rescale target")
             return
         prev = self.n_workers
+        step_at = int(np.asarray(jax.device_get(self.state.step)))
         log.info("reshard begin", from_workers=prev, to_workers=target)
         with Timer() as stall:
-            host = ckpt.snapshot(self.state)  # device -> host RAM
+            old_state = self.state
             self._build(target)  # new mesh over new device set
-            self.state = ckpt.restore(  # host RAM -> new sharding
-                host, self.plan, self.mesh, self.param_pspecs
-            )
+            try:
+                # fast path: direct device-to-device reshard (rides ICI on
+                # real hardware; surviving shards move, no host round trip)
+                self.state = _device_reshard(
+                    old_state, self.plan, self.mesh, self._pspecs
+                )
+            except Exception as e:  # fall back to host-RAM staging
+                log.warn("device reshard failed; staging via host", error=str(e))
+                host = ckpt.snapshot(old_state)
+                self.state = ckpt.restore(host, self.plan, self.mesh, self._pspecs)
+            del old_state
         ev = ReshardEvent(
             from_workers=prev,
             to_workers=target,
             stall_s=stall.elapsed,
             recompile_s=0.0,  # filled after the first step on the new mesh
-            step=int(np.asarray(host.step)),
+            step=step_at,
         )
         self.report.reshards.append(ev)
         log.info(

@@ -107,17 +107,21 @@ class LocalJobRunner:
         """Train until ``n_steps`` or (with a queue) until the data queue
         drains; then mark the worker group complete so the updater's
         convert() lands the job in SUCCEEDED."""
-        if n_steps is not None:
-            report = self.trainer.train_steps(data_fn, n_steps)
-        else:
-            assert queue is not None, "need n_steps or a queue"
-            report = self.trainer.report
-            while not queue.done():
-                self.sync_membership()
-                report = self.trainer.train_steps(data_fn, 1)
-        cluster = self.controller.cluster
-        if hasattr(cluster, "finish_workers"):
-            cluster.finish_workers(self.job.namespace, f"{self.job.name}-worker")
-        self.controller.step()
-        self.detach()
+        try:
+            if n_steps is not None:
+                report = self.trainer.train_steps(data_fn, n_steps)
+            else:
+                assert queue is not None, "need n_steps or a queue"
+                report = self.trainer.report
+                while not queue.done():
+                    self.sync_membership()
+                    report = self.trainer.train_steps(data_fn, 1)
+            cluster = self.controller.cluster
+            if hasattr(cluster, "finish_workers"):
+                cluster.finish_workers(
+                    self.job.namespace, f"{self.job.name}-worker"
+                )
+            self.controller.step()
+        finally:
+            self.detach()
         return report

@@ -43,15 +43,29 @@ class TrainState:
 def state_pspecs(state: TrainState, plan: MeshPlan, param_pspecs=None):
     """PartitionSpec tree matching a TrainState: params per the plan (or
     explicit model-provided specs), optimizer moments shard like their
-    params (shape-matched), scalars replicated."""
+    params (shape-matched — a TP-sharded weight gets TP-sharded Adam
+    moments), scalars replicated."""
     p_specs = param_pspecs if param_pspecs is not None else shd.param_pspecs(
         state.params, plan
     )
     fsdp = plan.axis_size("fsdp")
-    opt_specs = jax.tree_util.tree_map(
-        lambda leaf: shd.fsdp_pspec(getattr(leaf, "shape", ()), fsdp),
-        state.opt_state,
-    )
+    # Optimizer moments mirror their parameter's spec. optax moment trees
+    # have param-shaped leaves; match them by shape (explicit TP specs
+    # must carry over, not just the fsdp default).
+    shape_to_spec = {}
+    for p_leaf, s_leaf in zip(
+        jax.tree_util.tree_leaves(state.params),
+        jax.tree_util.tree_leaves(p_specs, is_leaf=lambda x: isinstance(x, P)),
+    ):
+        shape_to_spec.setdefault(getattr(p_leaf, "shape", ()), s_leaf)
+
+    def _opt_spec(leaf):
+        shape = getattr(leaf, "shape", ())
+        if shape in shape_to_spec:
+            return shape_to_spec[shape]
+        return shd.fsdp_pspec(shape, fsdp)
+
+    opt_specs = jax.tree_util.tree_map(_opt_spec, state.opt_state)
     return TrainState(step=P(), params=p_specs, opt_state=opt_specs)
 
 

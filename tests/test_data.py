@@ -61,6 +61,23 @@ def test_passes_replay():
     assert q.done()
 
 
+def test_queue_batcher_full_coverage_with_misaligned_sizes():
+    # chunk 64, batch 48: every sample must be delivered exactly once and
+    # tasks acked only when fully consumed.
+    import numpy as np
+
+    from edl_tpu.runtime.data import QueueBatcher
+
+    q = ElasticDataQueue(n_samples=320, chunk_size=64, passes=1)
+    data = np.arange(320)
+    b = QueueBatcher(q, lambda t: {"i": data[t.start : t.end]})
+    seen = []
+    while (batch := b.next_batch(48)) is not None:
+        seen.extend(batch["i"].tolist())
+    assert sorted(seen) == list(range(320))  # exact coverage, no drops
+    assert q.done()
+
+
 def test_poison_task_dies_after_max_failures():
     q = ElasticDataQueue(n_samples=10, chunk_size=10, passes=1, lease_timeout_s=0.01)
     for _ in range(10):  # lease, let it expire, repeat past MAX_TASK_FAILURES

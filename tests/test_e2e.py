@@ -13,7 +13,7 @@ from edl_tpu.api.job import JobPhase, TrainingJob
 from edl_tpu.cluster.fake import FakeCluster, FakeHost
 from edl_tpu.controller.controller import Controller
 from edl_tpu.models import ctr, linreg
-from edl_tpu.runtime.data import ElasticDataQueue
+from edl_tpu.runtime.data import ElasticDataQueue, QueueBatcher
 from edl_tpu.runtime.local import LocalJobRunner
 
 JOB_YAML = """
@@ -94,15 +94,17 @@ def test_kill_worker_job_finishes_anyway(cpu_devices):
 
     queue = ElasticDataQueue(n_samples=640, chunk_size=64, passes=1)
     x, y = linreg.synthetic_dataset(640)
+    batcher = QueueBatcher(
+        queue, lambda t: {"x": x[t.start : t.end], "y": y[t.start : t.end]}
+    )
 
     def data_fn(bs):
-        t = queue.get_task("w")
-        if t is None:
+        b = batcher.next_batch(bs)
+        if b is None or b["x"].shape[0] < bs:
+            # queue drained mid-batch: pad with wraparound (jit needs a
+            # stable shape); the short remainder still got trained
             return {"x": x[:bs], "y": y[:bs]}
-        sl = slice(t.start, min(t.end, t.start + bs))
-        out = {"x": x[sl], "y": y[sl]}
-        queue.ack(t.task_id)
-        return out
+        return b
 
     runner = LocalJobRunner(
         ctl,

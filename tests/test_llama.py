@@ -1,0 +1,108 @@
+"""Llama decoder: correctness, TP×FSDP sharded training, elastic reshard."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import PartitionSpec as P
+
+from edl_tpu.api.job import MeshSpec
+from edl_tpu.models import llama
+from edl_tpu.parallel.mesh import MeshPlan
+from edl_tpu.runtime.elastic import ElasticTrainer
+from edl_tpu.train.trainer import TrainState, global_batch, make_train_step, shard_state
+
+
+def test_forward_shapes_and_causality():
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    toks = np.arange(2 * 16, dtype=np.int32).reshape(2, 16) % cfg.vocab
+    logits = llama.forward(params, jnp.asarray(toks), cfg)
+    assert logits.shape == (2, 16, cfg.vocab)
+    # causality: changing a future token must not affect earlier logits
+    toks2 = toks.copy()
+    toks2[:, 10:] = (toks2[:, 10:] + 7) % cfg.vocab
+    logits2 = llama.forward(params, jnp.asarray(toks2), cfg)
+    np.testing.assert_allclose(logits[:, :10], logits2[:, :10], atol=1e-5)
+    assert not np.allclose(logits[:, 10:], logits2[:, 10:])
+
+
+def test_tp_fsdp_training(cpu_devices):
+    cfg = llama.LlamaConfig.tiny()
+    plan = MeshPlan.create(dp=2, fsdp=2, tp=2)
+    mesh = plan.build()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    pspecs = llama.param_pspecs(cfg, plan)
+    tx = optax.adam(3e-3)
+    state = shard_state(TrainState.create(params, tx), plan, mesh, pspecs)
+    # tp really shards the head dim; fsdp really shards d_model
+    wq = state.params["layers"]["wq"]
+    wq_shard = (cfg.n_layers, cfg.d_model // 2, cfg.n_heads * cfg.head_dim // 2)
+    assert {s.data.shape for s in wq.addressable_shards} == {wq_shard}
+    # Adam moments must mirror the TP sharding of their params
+    mu_wq = state.opt_state[0].mu["layers"]["wq"]
+    assert {s.data.shape for s in mu_wq.addressable_shards} == {wq_shard}
+    loss_fn = llama.make_loss_fn(cfg)
+    step = make_train_step(loss_fn, tx, plan, mesh, param_pspecs=pspecs)
+    rng = np.random.RandomState(0)
+    losses = []
+    for _ in range(15):
+        b = llama.synthetic_tokens(rng, 16, 32, cfg.vocab)
+        state, m = step(state, global_batch(b, plan, mesh))
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0] - 0.5, losses
+
+
+def test_tp_matches_unsharded(cpu_devices):
+    # The sharding must be a layout choice: tp=2/fsdp=2 loss == dp loss.
+    cfg = llama.LlamaConfig.tiny()
+    rng_batches = [
+        llama.synthetic_tokens(np.random.RandomState(i), 8, 16, cfg.vocab)
+        for i in range(3)
+    ]
+
+    def run(plan, pspecs):
+        mesh = plan.build()
+        params = llama.init_params(jax.random.PRNGKey(1), cfg)
+        tx = optax.sgd(1e-2)
+        state = shard_state(TrainState.create(params, tx), plan, mesh, pspecs)
+        step = make_train_step(llama.make_loss_fn(cfg), tx, plan, mesh, pspecs)
+        out = []
+        for b in rng_batches:
+            state, m = step(state, global_batch(b, plan, mesh))
+            out.append(float(m["loss"]))
+        return out
+
+    plan_tp = MeshPlan.create(dp=2, fsdp=2, tp=2)
+    l_tp = run(plan_tp, llama.param_pspecs(cfg, plan_tp))
+    plan_dp = MeshPlan.data_parallel(8)
+    l_dp = run(plan_dp, None)
+    np.testing.assert_allclose(l_tp, l_dp, rtol=1e-4, atol=1e-5)
+
+
+def test_llama_elastic_fsdp_reshard(cpu_devices):
+    # The BASELINE headline config in miniature: elastic FSDP llama.
+    cfg = llama.LlamaConfig.tiny()
+    plan_spec = MeshSpec(fsdp=2)
+    tr = ElasticTrainer(
+        llama.make_loss_fn(cfg),
+        optax.adam(1e-3),
+        mesh_spec=plan_spec,
+        chips_per_worker=2,
+        per_chip_batch=4,
+        # plan-aware: re-evaluated at every reshard
+        param_pspecs=lambda plan: llama.param_pspecs(cfg, plan),
+    )
+    tr.start(llama.init_params(jax.random.PRNGKey(0), cfg), n_workers=2)
+    rng = np.random.RandomState(0)
+
+    def data(bs):
+        return llama.synthetic_tokens(rng, bs, 16, cfg.vocab)
+
+    tr.train_steps(data, 3)
+    tr.request_rescale(4)
+    tr.train_steps(data, 3)
+    assert tr.n_workers == 4
+    assert tr.plan.describe() == {"dp": 4, "fsdp": 2}
+    assert len(tr.report.reshards) == 1
+    assert int(tr.state.step) == 6
