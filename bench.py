@@ -52,10 +52,12 @@ def main() -> None:
     # NOTE: on tunneled backends block_until_ready can return before the
     # device work completes; a scalar value fetch is the reliable fence.
     t_compile = time.perf_counter()
-    for i in range(WARMUP):
+    state, m = step(state, batches[0])
+    float(m["loss"])  # fence: compile + first step only
+    compile_s = time.perf_counter() - t_compile
+    for i in range(1, WARMUP):
         state, m = step(state, batches[i % len(batches)])
     float(m["loss"])
-    compile_s = time.perf_counter() - t_compile
 
     t0 = time.perf_counter()
     for i in range(MEASURE):

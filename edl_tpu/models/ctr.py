@@ -66,15 +66,6 @@ def forward(params, dense: jnp.ndarray, sparse: jnp.ndarray) -> jnp.ndarray:
     return (x @ params["out"]["w"] + params["out"]["b"])[:, 0]  # [B]
 
 
-def loss_fn(params, batch) -> jnp.ndarray:
-    """Sigmoid cross-entropy (reference: log loss on the ctr_dnn output)."""
-    logits = forward(params, batch["dense"], batch["sparse"])
-    labels = batch["label"].astype(logits.dtype)
-    return jnp.mean(
-        jnp.maximum(logits, 0) - logits * labels + jnp.log1p(jnp.exp(-jnp.abs(logits)))
-    )
-
-
 def make_loss_fn(compute_dtype=jnp.float32):
     """Loss with a cast-to-``compute_dtype`` forward (bfloat16 feeds the
     MXU at full rate; params/optimizer stay float32). Loss is always
@@ -98,6 +89,11 @@ def make_loss_fn(compute_dtype=jnp.float32):
         )
 
     return _loss
+
+
+# Sigmoid cross-entropy at f32 (reference: log loss on the ctr_dnn
+# output) — the default loss; bfloat16 variants via make_loss_fn.
+loss_fn = make_loss_fn()
 
 
 def batch_auc(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:

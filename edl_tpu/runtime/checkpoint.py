@@ -108,4 +108,11 @@ def load(path: str, like: TrainState) -> TrainState:
 
 def load_metadata(path: str) -> Dict[str, Any]:
     with np.load(os.path.join(path, "state.npz")) as z:
-        return json.loads(bytes(z["meta"]).decode())
+        if "meta" in z.files:
+            return json.loads(bytes(z["meta"]).decode())
+    # legacy layout: meta.json sidecar next to the npz
+    sidecar = os.path.join(path, "meta.json")
+    if os.path.exists(sidecar):
+        with open(sidecar) as f:
+            return json.load(f)
+    return {}

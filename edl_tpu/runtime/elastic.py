@@ -42,26 +42,9 @@ log = kv_logger("elastic")
 def _device_reshard(state: TrainState, plan: MeshPlan, mesh, pspecs) -> TrainState:
     """Move a live device-resident TrainState onto a (different) mesh by
     direct ``jax.device_put`` — XLA routes shard movement device-to-device
-    where device sets overlap, which is the elastic fast path."""
-    from edl_tpu.train.trainer import state_pspecs as _sp
-    from edl_tpu.parallel import sharding as shd
-
-    sp = _sp(state, plan, pspecs)
-    new_state = TrainState(
-        step=jax.device_put(
-            jax.device_get(state.step), plan.replicated(mesh)
-        ),
-        params=jax.tree_util.tree_map(
-            lambda x, s: jax.device_put(x, s),
-            state.params,
-            shd.named(sp.params, mesh),
-        ),
-        opt_state=jax.tree_util.tree_map(
-            lambda x, s: jax.device_put(x, s),
-            state.opt_state,
-            shd.named(sp.opt_state, mesh),
-        ),
-    )
+    where device sets overlap, which is the elastic fast path. Same
+    placement rule as initial placement (shard_state), plus a fence."""
+    new_state = shard_state(state, plan, mesh, pspecs)
     jax.block_until_ready(new_state.params)
     return new_state
 
@@ -226,7 +209,9 @@ class ElasticTrainer:
                 self.state = _device_reshard(
                     old_state, self.plan, self.mesh, self._pspecs
                 )
-            except Exception as e:  # fall back to host-RAM staging
+            except (ValueError, TypeError, RuntimeError) as e:
+                # transfer-layer failures fall back to host-RAM staging;
+                # deterministic spec bugs will fail again here and surface
                 log.warn("device reshard failed; staging via host", error=str(e))
                 host = ckpt.snapshot(old_state)
                 self.state = ckpt.restore(host, self.plan, self.mesh, self._pspecs)

@@ -49,23 +49,41 @@ def state_pspecs(state: TrainState, plan: MeshPlan, param_pspecs=None):
         state.params, plan
     )
     fsdp = plan.axis_size("fsdp")
-    # Optimizer moments mirror their parameter's spec. optax moment trees
-    # have param-shaped leaves; match them by shape (explicit TP specs
-    # must carry over, not just the fsdp default).
-    shape_to_spec = {}
-    for p_leaf, s_leaf in zip(
-        jax.tree_util.tree_leaves(state.params),
-        jax.tree_util.tree_leaves(p_specs, is_leaf=lambda x: isinstance(x, P)),
-    ):
-        shape_to_spec.setdefault(getattr(p_leaf, "shape", ()), s_leaf)
+    # Optimizer moment trees (optax mu/nu) are structurally identical to
+    # the param tree — substitute the param spec tree for each such
+    # subtree so every moment shards exactly like its parameter (shape
+    # matching is NOT enough: wq [L,d,H] and wo [L,H,d] have equal shapes
+    # when d == H but transposed specs). Non-param leaves (counts,
+    # scalars) fall back to the fsdp rule.
+    param_treedef = jax.tree_util.tree_structure(state.params)
+    param_shapes = [
+        getattr(x, "shape", ()) for x in jax.tree_util.tree_leaves(state.params)
+    ]
 
-    def _opt_spec(leaf):
-        shape = getattr(leaf, "shape", ())
-        if shape in shape_to_spec:
-            return shape_to_spec[shape]
-        return shd.fsdp_pspec(shape, fsdp)
+    def _is_param_shaped(node) -> bool:
+        try:
+            if jax.tree_util.tree_structure(node) != param_treedef:
+                return False
+        except Exception:
+            return False
+        shapes = [
+            getattr(x, "shape", ()) for x in jax.tree_util.tree_leaves(node)
+        ]
+        return shapes == param_shapes
 
-    opt_specs = jax.tree_util.tree_map(_opt_spec, state.opt_state)
+    def _rec(node):
+        if _is_param_shaped(node):
+            return p_specs
+        if isinstance(node, dict):
+            return {k: _rec(v) for k, v in node.items()}
+        if isinstance(node, tuple):
+            vals = [_rec(v) for v in node]
+            return type(node)(*vals) if hasattr(node, "_fields") else tuple(vals)
+        if isinstance(node, list):
+            return [_rec(v) for v in node]
+        return shd.fsdp_pspec(getattr(node, "shape", ()), fsdp)
+
+    opt_specs = _rec(state.opt_state)
     return TrainState(step=P(), params=p_specs, opt_state=opt_specs)
 
 

@@ -1,0 +1,105 @@
+// C API over edl::Coordinator for ctypes embedding (the in-process
+// mode: the per-job coordinator thread inside the controller or a
+// worker-0 process). Handle-based, no exceptions across the boundary.
+#include <cstring>
+
+#include "coordinator.h"
+
+using edl::Coordinator;
+using edl::Task;
+
+extern "C" {
+
+void* edl_coord_new(double member_ttl_s) { return new Coordinator(member_ttl_s); }
+void edl_coord_free(void* h) { delete static_cast<Coordinator*>(h); }
+
+// KV: get copies into caller buffer; returns value length or -1.
+void edl_kv_put(void* h, const char* k, const char* v) {
+  static_cast<Coordinator*>(h)->KvPut(k, v);
+}
+long long edl_kv_get(void* h, const char* k, char* buf, long long buflen) {
+  std::string v;
+  if (!static_cast<Coordinator*>(h)->KvGet(k, &v)) return -1;
+  long long n = static_cast<long long>(v.size());
+  if (buf && buflen > 0) {
+    long long c = n < buflen - 1 ? n : buflen - 1;
+    std::memcpy(buf, v.data(), static_cast<size_t>(c));
+    buf[c] = '\0';
+  }
+  return n;
+}
+void edl_kv_del(void* h, const char* k) { static_cast<Coordinator*>(h)->KvDel(k); }
+
+long long edl_member_register(void* h, const char* w, long long inc) {
+  return static_cast<Coordinator*>(h)->Register(w, inc);
+}
+int edl_member_heartbeat(void* h, const char* w) {
+  return static_cast<Coordinator*>(h)->Heartbeat(w) ? 1 : 0;
+}
+long long edl_member_leave(void* h, const char* w) {
+  return static_cast<Coordinator*>(h)->Leave(w);
+}
+long long edl_member_expire(void* h) {
+  return static_cast<Coordinator*>(h)->ExpireMembers();
+}
+long long edl_epoch(void* h) { return static_cast<Coordinator*>(h)->Epoch(); }
+
+// Members serialized "name:incarnation:rank,..." into caller buffer;
+// returns needed length.
+long long edl_members(void* h, char* buf, long long buflen) {
+  std::string s;
+  for (const auto& m : static_cast<Coordinator*>(h)->Members()) {
+    if (!s.empty()) s += ',';
+    s += m.name + ":" + std::to_string(m.incarnation) + ":" +
+         std::to_string(m.rank);
+  }
+  long long n = static_cast<long long>(s.size());
+  if (buf && buflen > 0) {
+    long long c = n < buflen - 1 ? n : buflen - 1;
+    std::memcpy(buf, s.data(), static_cast<size_t>(c));
+    buf[c] = '\0';
+  }
+  return n;
+}
+
+int edl_barrier_arrive(void* h, const char* name, const char* worker) {
+  return static_cast<Coordinator*>(h)->BarrierArrive(name, worker);
+}
+int edl_barrier_count(void* h, const char* name) {
+  return static_cast<Coordinator*>(h)->BarrierCount(name);
+}
+
+void edl_queue_init(void* h, long long n_samples, long long chunk, int passes,
+                    double lease_timeout_s, int max_failures) {
+  static_cast<Coordinator*>(h)->QueueInit(n_samples, chunk, passes,
+                                          lease_timeout_s, max_failures);
+}
+// out: [id, start, end, epoch]; returns 1 on lease, 0 when none available.
+int edl_queue_lease(void* h, const char* worker, long long out[4]) {
+  Task t;
+  if (!static_cast<Coordinator*>(h)->Lease(worker, &t)) return 0;
+  out[0] = t.id;
+  out[1] = t.start;
+  out[2] = t.end;
+  out[3] = t.epoch;
+  return 1;
+}
+int edl_queue_ack(void* h, long long id) {
+  return static_cast<Coordinator*>(h)->Ack(id) ? 1 : 0;
+}
+int edl_queue_nack(void* h, long long id) {
+  return static_cast<Coordinator*>(h)->Nack(id) ? 1 : 0;
+}
+int edl_queue_release_worker(void* h, const char* worker) {
+  return static_cast<Coordinator*>(h)->ReleaseWorker(worker);
+}
+int edl_queue_done(void* h) {
+  return static_cast<Coordinator*>(h)->QueueDone() ? 1 : 0;
+}
+void edl_queue_stats(void* h, long long out[5]) {
+  int64_t s[5];
+  static_cast<Coordinator*>(h)->QueueStats(s);
+  for (int i = 0; i < 5; ++i) out[i] = s[i];
+}
+
+}  // extern "C"

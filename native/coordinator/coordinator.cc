@@ -1,0 +1,236 @@
+#include "coordinator.h"
+
+#include <algorithm>
+#include <chrono>
+
+namespace edl {
+
+double Coordinator::Now() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+// ---------------------------------------------------------------- KV
+
+void Coordinator::KvPut(const std::string& key, const std::string& value) {
+  std::lock_guard<std::mutex> lock(mu_);
+  kv_[key] = value;
+}
+
+bool Coordinator::KvGet(const std::string& key, std::string* value) const {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = kv_.find(key);
+  if (it == kv_.end()) return false;
+  *value = it->second;
+  return true;
+}
+
+void Coordinator::KvDel(const std::string& key) {
+  std::lock_guard<std::mutex> lock(mu_);
+  kv_.erase(key);
+}
+
+// -------------------------------------------------------- membership
+
+int64_t Coordinator::Register(const std::string& worker, int64_t incarnation) {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = members_.find(worker);
+  // A re-registration with a stale incarnation is a zombie: ignore it
+  // (the coordinator owns incarnation ordering — SURVEY §7 hard part (a)).
+  if (it != members_.end() && it->second.incarnation > incarnation) {
+    return epoch_;
+  }
+  bool is_new = it == members_.end() || it->second.incarnation != incarnation;
+  members_[worker] = Member{incarnation, Now() + member_ttl_s_};
+  if (is_new) ++epoch_;
+  return epoch_;
+}
+
+bool Coordinator::Heartbeat(const std::string& worker) {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = members_.find(worker);
+  if (it == members_.end()) return false;
+  it->second.expires = Now() + member_ttl_s_;
+  return true;
+}
+
+int64_t Coordinator::Leave(const std::string& worker) {
+  std::lock_guard<std::mutex> lock(mu_);
+  if (members_.erase(worker) > 0) ++epoch_;
+  return epoch_;
+}
+
+int64_t Coordinator::ExpireMembers() {
+  std::lock_guard<std::mutex> lock(mu_);
+  double now = Now();
+  bool changed = false;
+  for (auto it = members_.begin(); it != members_.end();) {
+    if (it->second.expires <= now) {
+      it = members_.erase(it);
+      changed = true;
+    } else {
+      ++it;
+    }
+  }
+  if (changed) ++epoch_;
+  return epoch_;
+}
+
+int64_t Coordinator::Epoch() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return epoch_;
+}
+
+std::vector<MemberInfo> Coordinator::Members() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  std::vector<MemberInfo> out;
+  out.reserve(members_.size());
+  // std::map iterates sorted by name: rank = dense index.
+  int32_t rank = 0;
+  for (const auto& [name, m] : members_) {
+    out.push_back(MemberInfo{name, m.incarnation, rank++});
+  }
+  return out;
+}
+
+// ---------------------------------------------------------- barriers
+
+int32_t Coordinator::BarrierArrive(const std::string& name,
+                                   const std::string& worker) {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto& parties = barriers_[name];
+  parties[worker] = true;
+  return static_cast<int32_t>(parties.size());
+}
+
+int32_t Coordinator::BarrierCount(const std::string& name) const {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = barriers_.find(name);
+  return it == barriers_.end() ? 0 : static_cast<int32_t>(it->second.size());
+}
+
+// -------------------------------------------------------- task queue
+
+void Coordinator::QueueInit(int64_t n_samples, int64_t chunk, int32_t passes,
+                            double lease_timeout_s, int32_t max_failures) {
+  std::lock_guard<std::mutex> lock(mu_);
+  todo_.clear();
+  leases_.clear();
+  dead_.clear();
+  next_task_id_ = 0;
+  done_count_ = 0;
+  q_epoch_ = 0;
+  n_samples_ = n_samples;
+  chunk_ = chunk;
+  passes_ = passes;
+  lease_timeout_s_ = lease_timeout_s;
+  max_failures_ = max_failures;
+  queue_ready_ = n_samples > 0 && chunk > 0;
+  if (queue_ready_) FillEpochLocked(0);
+}
+
+void Coordinator::FillEpochLocked(int32_t epoch) {
+  for (int64_t start = 0; start < n_samples_; start += chunk_) {
+    Task t;
+    t.id = next_task_id_++;
+    t.start = start;
+    t.end = std::min(start + chunk_, n_samples_);
+    t.epoch = epoch;
+    todo_.push_back(t);
+  }
+}
+
+void Coordinator::RequeueLocked(Task t) {
+  t.failures += 1;
+  if (t.failures > max_failures_) {
+    dead_.push_back(t);
+  } else {
+    todo_.push_back(t);
+  }
+}
+
+void Coordinator::ReapLeasesLocked(double now) {
+  for (auto it = leases_.begin(); it != leases_.end();) {
+    if (it->second.expires <= now) {
+      RequeueLocked(it->second.task);
+      it = leases_.erase(it);
+    } else {
+      ++it;
+    }
+  }
+}
+
+bool Coordinator::AdvanceEpochLocked() {
+  if (q_epoch_ < passes_ - 1) {
+    ++q_epoch_;
+    FillEpochLocked(q_epoch_);
+    return true;
+  }
+  return false;
+}
+
+bool Coordinator::Lease(const std::string& worker, Task* out) {
+  std::lock_guard<std::mutex> lock(mu_);
+  if (!queue_ready_) return false;
+  ReapLeasesLocked(Now());
+  if (todo_.empty() && leases_.empty()) AdvanceEpochLocked();
+  if (todo_.empty()) return false;
+  Task t = todo_.front();
+  todo_.pop_front();
+  leases_[t.id] = LeaseRec{t, worker, Now() + lease_timeout_s_};
+  *out = t;
+  return true;
+}
+
+bool Coordinator::Ack(int64_t task_id) {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = leases_.find(task_id);
+  if (it == leases_.end()) return false;
+  leases_.erase(it);
+  ++done_count_;
+  if (todo_.empty() && leases_.empty()) AdvanceEpochLocked();
+  return true;
+}
+
+bool Coordinator::Nack(int64_t task_id) {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = leases_.find(task_id);
+  if (it == leases_.end()) return false;
+  RequeueLocked(it->second.task);
+  leases_.erase(it);
+  return true;
+}
+
+int32_t Coordinator::ReleaseWorker(const std::string& worker) {
+  std::lock_guard<std::mutex> lock(mu_);
+  int32_t n = 0;
+  for (auto it = leases_.begin(); it != leases_.end();) {
+    if (it->second.worker == worker) {
+      RequeueLocked(it->second.task);
+      it = leases_.erase(it);
+      ++n;
+    } else {
+      ++it;
+    }
+  }
+  return n;
+}
+
+bool Coordinator::QueueDone() {
+  std::lock_guard<std::mutex> lock(mu_);
+  if (!queue_ready_) return false;
+  ReapLeasesLocked(Now());
+  return todo_.empty() && leases_.empty() && q_epoch_ >= passes_ - 1;
+}
+
+void Coordinator::QueueStats(int64_t out[5]) {
+  std::lock_guard<std::mutex> lock(mu_);
+  out[0] = static_cast<int64_t>(todo_.size());
+  out[1] = static_cast<int64_t>(leases_.size());
+  out[2] = done_count_;
+  out[3] = static_cast<int64_t>(dead_.size());
+  out[4] = q_epoch_;
+}
+
+}  // namespace edl

@@ -1,0 +1,124 @@
+// Coordinator core — the native replacement for the reference's etcd
+// sidecar + Paddle master binary (reference: pkg/jobparser.go:167-184
+// runs etcd; docker/paddle_k8s:26-32 runs /usr/bin/master with
+// -chunk-per-task=1 -task-timout-dur=16s). One in-memory service owning:
+//
+//   * KV store            (etcd analog: discovery, config fan-out)
+//   * membership registry  with incarnation numbers + TTL heartbeats —
+//                          the epoch bump is what triggers an elastic
+//                          reshard on the JAX side
+//   * named barriers       (start barriers, reference: docker/paddle_k8s
+//                          wait_pods_running)
+//   * chunked task queue   with leases + timeout redelivery (master
+//                          task-queue analog)
+//
+// Thread-safe; embedded via the C API (capi.cc -> ctypes) or served over
+// TCP (server_main.cc) for multi-host jobs.
+#pragma once
+
+#include <cstdint>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace edl {
+
+struct Task {
+  int64_t id = -1;
+  int64_t start = 0;
+  int64_t end = 0;
+  int32_t epoch = 0;
+  int32_t failures = 0;
+};
+
+struct MemberInfo {
+  std::string name;
+  int64_t incarnation = 0;
+  int32_t rank = -1;  // dense rank: index in sorted live-member names
+};
+
+class Coordinator {
+ public:
+  explicit Coordinator(double member_ttl_s = 10.0) : member_ttl_s_(member_ttl_s) {}
+
+  // -- KV (etcd analog) ------------------------------------------------
+  void KvPut(const std::string& key, const std::string& value);
+  bool KvGet(const std::string& key, std::string* value) const;
+  void KvDel(const std::string& key);
+
+  // -- membership ------------------------------------------------------
+  // Register (or re-register with a higher incarnation). Returns the
+  // membership epoch after the change.
+  int64_t Register(const std::string& worker, int64_t incarnation);
+  // Heartbeat; false if the worker is unknown (must re-register).
+  bool Heartbeat(const std::string& worker);
+  // Graceful leave.
+  int64_t Leave(const std::string& worker);
+  // Reap expired members; returns current epoch (bumped if any died).
+  int64_t ExpireMembers();
+  int64_t Epoch() const;
+  // Live members sorted by name; rank = position (deterministic rank
+  // assignment, reference: docker/k8s_tools.py:127-151 fetch_pod_id).
+  std::vector<MemberInfo> Members() const;
+
+  // -- barriers --------------------------------------------------------
+  // Arrive at a named barrier expecting n parties; returns the arrival
+  // count so far (callers poll until count >= n, matching the polling
+  // style of the reference's wait loops).
+  int32_t BarrierArrive(const std::string& name, const std::string& worker);
+  int32_t BarrierCount(const std::string& name) const;
+
+  // -- task queue (master analog) --------------------------------------
+  void QueueInit(int64_t n_samples, int64_t chunk, int32_t passes,
+                 double lease_timeout_s, int32_t max_failures = 3);
+  bool Lease(const std::string& worker, Task* out);
+  bool Ack(int64_t task_id);
+  bool Nack(int64_t task_id);
+  int32_t ReleaseWorker(const std::string& worker);
+  bool QueueDone();
+  // todo, leased, done, dead, epoch
+  void QueueStats(int64_t out[5]);
+
+ private:
+  void FillEpochLocked(int32_t epoch);
+  void RequeueLocked(Task t);
+  void ReapLeasesLocked(double now);
+  bool AdvanceEpochLocked();
+  static double Now();
+
+  mutable std::mutex mu_;
+  double member_ttl_s_;
+
+  std::map<std::string, std::string> kv_;
+
+  struct Member {
+    int64_t incarnation = 0;
+    double expires = 0;
+  };
+  std::map<std::string, Member> members_;
+  int64_t epoch_ = 0;
+
+  std::map<std::string, std::map<std::string, bool>> barriers_;
+
+  std::deque<Task> todo_;
+  struct LeaseRec {
+    Task task;
+    std::string worker;
+    double expires = 0;
+  };
+  std::map<int64_t, LeaseRec> leases_;
+  std::vector<Task> dead_;
+  int64_t next_task_id_ = 0;
+  int64_t n_samples_ = 0;
+  int64_t chunk_ = 0;
+  int32_t passes_ = 1;
+  int32_t q_epoch_ = 0;
+  int64_t done_count_ = 0;
+  int32_t max_failures_ = 3;
+  double lease_timeout_s_ = 16.0;
+  bool queue_ready_ = false;
+};
+
+}  // namespace edl

@@ -1,0 +1,203 @@
+// edl-coordinator — TCP service wrapping edl::Coordinator for multi-host
+// jobs (the standalone analog of the reference's master+etcd pod,
+// reference: pkg/jobparser.go:186-227). Line protocol, thread per
+// connection, values must be newline-free (discovery strings).
+//
+//   PUT <key> <value...>        -> OK
+//   GET <key>                   -> VAL <value...> | NONE
+//   DEL <key>                   -> OK
+//   REG <worker> <incarnation>  -> EPOCH <n>
+//   HB <worker>                 -> OK | UNKNOWN
+//   LEAVE <worker>              -> EPOCH <n>
+//   EXPIRE                      -> EPOCH <n>
+//   EPOCH                       -> EPOCH <n>
+//   MEMBERS                     -> MEMBERS name:inc:rank,... | MEMBERS
+//   BARRIER <name> <worker>     -> COUNT <n>
+//   BCOUNT <name>               -> COUNT <n>
+//   QINIT <n> <chunk> <passes> <timeout_s> -> OK
+//   LEASE <worker>              -> TASK <id> <start> <end> <epoch> | NONE
+//   ACK <id> / NACK <id>        -> OK | UNKNOWN
+//   RELEASE <worker>            -> COUNT <n>
+//   QDONE                       -> DONE 0|1
+//   QSTATS                      -> STATS todo leased done dead epoch
+//   PING                        -> PONG
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <csignal>
+#include <cstdio>
+#include <cstring>
+#include <sstream>
+#include <string>
+#include <thread>
+
+#include "coordinator.h"
+
+namespace {
+
+edl::Coordinator* g_coord = nullptr;
+
+std::string Handle(const std::string& line) {
+  std::istringstream in(line);
+  std::string cmd;
+  in >> cmd;
+  auto rest_of_line = [&in]() {
+    std::string rest;
+    std::getline(in, rest);
+    if (!rest.empty() && rest[0] == ' ') rest.erase(0, 1);
+    return rest;
+  };
+  if (cmd == "PING") return "PONG";
+  if (cmd == "PUT") {
+    std::string k;
+    in >> k;
+    g_coord->KvPut(k, rest_of_line());
+    return "OK";
+  }
+  if (cmd == "GET") {
+    std::string k, v;
+    in >> k;
+    return g_coord->KvGet(k, &v) ? "VAL " + v : "NONE";
+  }
+  if (cmd == "DEL") {
+    std::string k;
+    in >> k;
+    g_coord->KvDel(k);
+    return "OK";
+  }
+  if (cmd == "REG") {
+    std::string w;
+    long long inc = 0;
+    in >> w >> inc;
+    return "EPOCH " + std::to_string(g_coord->Register(w, inc));
+  }
+  if (cmd == "HB") {
+    std::string w;
+    in >> w;
+    return g_coord->Heartbeat(w) ? "OK" : "UNKNOWN";
+  }
+  if (cmd == "LEAVE") {
+    std::string w;
+    in >> w;
+    return "EPOCH " + std::to_string(g_coord->Leave(w));
+  }
+  if (cmd == "EXPIRE") return "EPOCH " + std::to_string(g_coord->ExpireMembers());
+  if (cmd == "EPOCH") return "EPOCH " + std::to_string(g_coord->Epoch());
+  if (cmd == "MEMBERS") {
+    std::string s;
+    for (const auto& m : g_coord->Members()) {
+      if (!s.empty()) s += ',';
+      s += m.name + ":" + std::to_string(m.incarnation) + ":" +
+           std::to_string(m.rank);
+    }
+    return "MEMBERS " + s;
+  }
+  if (cmd == "BARRIER") {
+    std::string name, w;
+    in >> name >> w;
+    return "COUNT " + std::to_string(g_coord->BarrierArrive(name, w));
+  }
+  if (cmd == "BCOUNT") {
+    std::string name;
+    in >> name;
+    return "COUNT " + std::to_string(g_coord->BarrierCount(name));
+  }
+  if (cmd == "QINIT") {
+    long long n = 0, chunk = 0;
+    int passes = 1;
+    double timeout = 16.0;
+    in >> n >> chunk >> passes >> timeout;
+    g_coord->QueueInit(n, chunk, passes, timeout);
+    return "OK";
+  }
+  if (cmd == "LEASE") {
+    std::string w;
+    in >> w;
+    edl::Task t;
+    if (!g_coord->Lease(w, &t)) return "NONE";
+    return "TASK " + std::to_string(t.id) + " " + std::to_string(t.start) +
+           " " + std::to_string(t.end) + " " + std::to_string(t.epoch);
+  }
+  if (cmd == "ACK" || cmd == "NACK") {
+    long long id = -1;
+    in >> id;
+    bool ok = cmd == "ACK" ? g_coord->Ack(id) : g_coord->Nack(id);
+    return ok ? "OK" : "UNKNOWN";
+  }
+  if (cmd == "RELEASE") {
+    std::string w;
+    in >> w;
+    return "COUNT " + std::to_string(g_coord->ReleaseWorker(w));
+  }
+  if (cmd == "QDONE") return std::string("DONE ") + (g_coord->QueueDone() ? "1" : "0");
+  if (cmd == "QSTATS") {
+    int64_t s[5];
+    g_coord->QueueStats(s);
+    std::string out = "STATS";
+    for (int i = 0; i < 5; ++i) out += " " + std::to_string(s[i]);
+    return out;
+  }
+  return "ERR unknown command";
+}
+
+void Serve(int fd) {
+  std::string buf;
+  char chunk[4096];
+  for (;;) {
+    ssize_t n = read(fd, chunk, sizeof(chunk));
+    if (n <= 0) break;
+    buf.append(chunk, static_cast<size_t>(n));
+    size_t pos;
+    while ((pos = buf.find('\n')) != std::string::npos) {
+      std::string line = buf.substr(0, pos);
+      buf.erase(0, pos + 1);
+      if (!line.empty() && line.back() == '\r') line.pop_back();
+      std::string resp = Handle(line) + "\n";
+      if (write(fd, resp.data(), resp.size()) < 0) {
+        close(fd);
+        return;
+      }
+    }
+  }
+  close(fd);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int port = 7164;  // the reference's default job port (pkg/jobparser.go:50)
+  double ttl = 10.0;
+  for (int i = 1; i < argc - 1; ++i) {
+    if (!strcmp(argv[i], "--port")) port = atoi(argv[i + 1]);
+    if (!strcmp(argv[i], "--member-ttl")) ttl = atof(argv[i + 1]);
+  }
+  signal(SIGPIPE, SIG_IGN);
+  g_coord = new edl::Coordinator(ttl);
+
+  int srv = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (bind(srv, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    perror("bind");
+    return 1;
+  }
+  if (listen(srv, 64) != 0) {
+    perror("listen");
+    return 1;
+  }
+  // readiness line on stdout (the launcher greps for it)
+  printf("edl-coordinator listening on %d\n", port);
+  fflush(stdout);
+  for (;;) {
+    int fd = accept(srv, nullptr, nullptr);
+    if (fd < 0) continue;
+    std::thread(Serve, fd).detach();
+  }
+}

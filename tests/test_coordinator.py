@@ -1,0 +1,186 @@
+"""Coordinator: native C++ core (ctypes), TCP server, Python fallback,
+and the worker bootstrap protocol over it."""
+
+import threading
+import time
+
+import pytest
+
+from edl_tpu.runtime import coordinator as coord_mod
+from edl_tpu.runtime.coordinator import (
+    CoordinatorServer,
+    PyCoordinator,
+    ensure_native_built,
+)
+from edl_tpu.runtime.entrypoint import (
+    FailureGateError,
+    bootstrap,
+    check_failure_gate,
+    record_failure,
+    run_worker,
+)
+
+HAVE_NATIVE = ensure_native_built()
+
+BACKENDS = ["py"] + (["native"] if HAVE_NATIVE else [])
+
+
+def make(backend, ttl=10.0):
+    if backend == "native":
+        return coord_mod.NativeCoordinator(ttl)
+    return PyCoordinator(ttl)
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_kv_and_membership(backend):
+    c = make(backend)
+    c.kv_put("a", "hello world")
+    assert c.kv_get("a") == "hello world"
+    c.kv_del("a")
+    assert c.kv_get("a") is None
+
+    e0 = c.register("w1", 1)
+    e1 = c.register("w0", 1)
+    assert e1 > e0
+    ms = c.members()
+    # deterministic rank: sorted by name (reference: k8s_tools fetch_pod_id)
+    assert [(m.name, m.rank) for m in ms] == [("w0", 0), ("w1", 1)]
+    assert c.heartbeat("w0")
+    assert not c.heartbeat("ghost")
+    # zombie with stale incarnation is ignored
+    c.register("w0", 5)
+    e_before = c.epoch()
+    c.register("w0", 3)
+    assert c.epoch() == e_before
+    e2 = c.leave("w1")
+    assert e2 > e1
+    assert len(c.members()) == 1
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_member_ttl_expiry_bumps_epoch(backend):
+    c = make(backend, ttl=0.05)
+    c.register("w0", 1)
+    e = c.epoch()
+    time.sleep(0.08)
+    assert c.expire() > e
+    assert c.members() == []
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_task_queue_parity(backend):
+    # the native queue must behave exactly like runtime/data.py
+    c = make(backend)
+    c.queue_init(100, 10, passes=2, lease_timeout_s=16.0)
+    seen = 0
+    while (t := c.lease("w0")) is not None:
+        seen += 1
+        assert c.ack(t.task_id)
+    assert seen == 20  # 10 chunks x 2 passes
+    assert c.queue_done()
+    stats = c.queue_stats()
+    assert stats["done"] == 20 and stats["todo"] == 0
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_queue_release_worker(backend):
+    c = make(backend)
+    c.queue_init(30, 10)
+    t0 = c.lease("w0")
+    t1 = c.lease("w1")
+    assert c.release_worker("w0") == 1
+    got = set()
+    while (t := c.lease("w1")) is not None:
+        got.add(t.start)
+        c.ack(t.task_id)
+    c.ack(t1.task_id)
+    assert t0.start in got
+
+
+@pytest.mark.skipif(not HAVE_NATIVE, reason="no C++ toolchain")
+def test_tcp_server_end_to_end():
+    with CoordinatorServer(member_ttl_s=5.0) as srv:
+        c1 = srv.client()
+        c2 = srv.client()
+        assert c1.ping()
+        c1.kv_put("discovery", "10.0.0.1:7164 10.0.0.2:7164")
+        assert c2.kv_get("discovery") == "10.0.0.1:7164 10.0.0.2:7164"
+        c1.register("host-a", 1)
+        c2.register("host-b", 1)
+        ms = c2.members()
+        assert [(m.name, m.rank) for m in ms] == [("host-a", 0), ("host-b", 1)]
+        c1.queue_init(64, 16, 1, 16.0)
+        t = c2.lease("host-b")
+        assert t is not None and (t.start, t.end) == (0, 16)
+        assert c2.ack(t.task_id)
+        c1.close()
+        c2.close()
+
+
+@pytest.mark.skipif(not HAVE_NATIVE, reason="no C++ toolchain")
+def test_worker_bootstrap_over_tcp():
+    # two workers bootstrap concurrently against the native server:
+    # barrier holds until both arrive, ranks are deterministic.
+    with CoordinatorServer(member_ttl_s=5.0) as srv:
+        results = {}
+        both_bootstrapped = threading.Barrier(2)
+
+        def worker(wid):
+            c = srv.client()
+            env = {
+                "EDL_JOB_NAME": "demo",
+                "EDL_WORKER_ID": wid,
+                "EDL_WORKERS": "2",
+                "EDL_WORKERS_MIN": "2",
+                "EDL_FAULT_TOLERANT": "1",
+            }
+            ctx = bootstrap(c, env, barrier_timeout_s=10.0)
+            results[wid] = ctx
+            both_bootstrapped.wait(timeout=10)  # hold membership steady
+            code = run_worker(ctx, lambda ctx: 0)
+            assert code == 0
+            c.close()
+
+        threads = [
+            threading.Thread(target=worker, args=(w,)) for w in ("wb", "wa")
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert results["wa"].rank == 0
+        assert results["wb"].rank == 1
+        assert results["wa"].world_size == 2
+        # both left cleanly
+        c = srv.client()
+        assert c.members() == []
+        c.close()
+
+
+def test_failure_gate():
+    c = PyCoordinator()
+    check_failure_gate(c, "j", fault_tolerant=True, budget=2)
+    record_failure(c, "j", "segfault")
+    record_failure(c, "j", "abort")
+    check_failure_gate(c, "j", True, budget=2)  # at budget: still ok
+    record_failure(c, "j", "oom")
+    with pytest.raises(FailureGateError):
+        check_failure_gate(c, "j", True, budget=2)
+    # non-FT: any failure trips the gate
+    with pytest.raises(FailureGateError):
+        check_failure_gate(c, "j", False, budget=2)
+
+
+def test_incarnation_monotonic_across_restarts():
+    c = PyCoordinator()
+    env = {
+        "EDL_JOB_NAME": "j",
+        "EDL_WORKER_ID": "w0",
+        "EDL_WORKERS_MIN": "1",
+        "EDL_FAULT_TOLERANT": "1",
+    }
+    ctx1 = bootstrap(c, env, barrier_timeout_s=1.0)
+    assert ctx1.incarnation == 1
+    run_worker(ctx1, lambda ctx: 0)
+    ctx2 = bootstrap(c, env, barrier_timeout_s=1.0)
+    assert ctx2.incarnation == 2  # restart gets a fresh incarnation

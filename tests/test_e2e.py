@@ -100,10 +100,11 @@ def test_kill_worker_job_finishes_anyway(cpu_devices):
 
     def data_fn(bs):
         b = batcher.next_batch(bs)
-        if b is None or b["x"].shape[0] < bs:
-            # queue drained mid-batch: pad with wraparound (jit needs a
-            # stable shape); the short remainder still got trained
+        if b is None:  # queue drained: recycle data to keep shapes stable
             return {"x": x[:bs], "y": y[:bs]}
+        if b["x"].shape[0] < bs:
+            # short tail: pad by wraparound so its samples still train
+            b = {k: np.resize(v, (bs,) + v.shape[1:]) for k, v in b.items()}
         return b
 
     runner = LocalJobRunner(
