@@ -38,6 +38,7 @@ class LlamaConfig:
     rope_theta: float = 500000.0
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16  # activation dtype (params stay f32)
+    use_flash: bool = False  # pallas flash attention (TPU, T % 128 == 0)
 
     @property
     def head_dim(self) -> int:
@@ -140,6 +141,13 @@ def attention(
 ) -> jnp.ndarray:
     """Causal GQA attention. q [B,T,H,hd]; k,v [B,T,KV,hd]."""
     b, t, h, hd = q.shape
+    if cfg.use_flash:
+        from edl_tpu.ops.flash_attention import attention_auto, flash_supported
+
+        if flash_supported(t):
+            # kernel handles GQA natively (no K/V repeat) and falls back
+            # to interpret mode off-TPU
+            return attention_auto(q, k, v, causal=True)
     groups = h // k.shape[2]
     k = jnp.repeat(k, groups, axis=2)
     v = jnp.repeat(v, groups, axis=2)

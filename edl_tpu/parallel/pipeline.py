@@ -1,0 +1,88 @@
+"""Pipeline parallelism — GPipe microbatch schedule over the ``pp`` axis.
+
+Absent from the reference (SURVEY §2.5: "Pipeline parallelism: NO").
+Stage parameters carry a leading [n_stages] axis sharded over pp (each
+device materializes only its stage); activations flow stage-to-stage
+with ``ppermute`` (ICI neighbor transfer). The schedule is the classic
+GPipe fill-drain loop: n_micro + n_stages - 1 ticks, bubble fraction
+(n_stages-1)/(n_micro+n_stages-1).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    stage_params: Any,
+    x: jnp.ndarray,
+    mesh: Mesh,
+    axis: str = "pp",
+):
+    """Run ``x`` through n_stages of ``stage_fn`` spread over the pp axis.
+
+    stage_params : pytree whose leaves have leading dim n_stages
+                   (sharded P(axis, ...)).
+    x : [n_micro, mb, ...] microbatched input (replicated over pp).
+    Returns [n_micro, mb, ...] outputs of the last stage (replicated).
+    """
+    n = mesh.shape[axis]
+    for leaf in jax.tree_util.tree_leaves(stage_params):
+        if leaf.shape[0] != n:
+            raise ValueError(
+                f"stage param leading dim {leaf.shape[0]} != pp axis size {n}"
+            )
+
+    pspec = jax.tree_util.tree_map(
+        lambda l: P(axis, *(None,) * (l.ndim - 1)), stage_params
+    )
+
+    def local(params, xm):
+        # params leaves: [1, ...] (this device's stage); squeeze
+        p = jax.tree_util.tree_map(lambda l: l[0], params)
+        stage = jax.lax.axis_index(axis)
+        n_micro = xm.shape[0]
+        total = n_micro + n - 1
+        mb_shape = xm.shape[1:]
+        perm_fwd = [(j, (j + 1) % n) for j in range(n)]
+
+        def tick(t, carry):
+            buf, out = carry
+            # stage 0 feeds microbatch t (while available); others take
+            # the activation passed from the previous stage
+            feed = xm[jnp.minimum(t, n_micro - 1)]
+            inp = jnp.where(stage == 0, feed, buf)
+            y = stage_fn(p, inp)
+            # last stage collects finished microbatch t-(n-1)
+            idx = t - (n - 1)
+            out = jax.lax.cond(
+                idx >= 0,
+                lambda o: jax.lax.dynamic_update_index_in_dim(o, y, jnp.maximum(idx, 0), 0),
+                lambda o: o,
+                out,
+            )
+            # pass activations to the next stage
+            buf = jax.lax.ppermute(y, axis, perm_fwd)
+            return buf, out
+
+        buf0 = jnp.zeros(mb_shape, xm.dtype)
+        out0 = jnp.zeros((n_micro,) + mb_shape, xm.dtype)
+        _, out = jax.lax.fori_loop(0, total, tick, (buf0, out0))
+        # `out` is populated only on the last stage; replicate it to all
+        # stages (zero elsewhere, so a psum is a broadcast)
+        mask = (stage == n - 1).astype(out.dtype)
+        return jax.lax.psum(out * mask, axis)
+
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(pspec, P()),
+        out_specs=P(),
+        check_rep=False,
+    )(stage_params, x)

@@ -1,0 +1,126 @@
+"""Ring attention — sequence/context parallelism over the ``sp`` axis.
+
+Long-context support the reference entirely lacks (SURVEY §5:
+"Long-context / sequence parallelism: absent"). Q/K/V are sharded along
+the sequence dimension across the ring; each step every device computes
+blockwise attention of its local queries against the K/V block currently
+resident, then rotates K/V to its ring neighbor with ``ppermute`` (ICI
+neighbor exchange — bandwidth-optimal on a TPU torus). Softmax is
+accumulated online (flash-style running max / sum), so the full score
+matrix never materializes and sequence length scales with the ring size.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+
+def _block_attn(q, k, v, scale, mask):
+    """One blockwise score pass. q [B,t,H,d]; k,v [B,s,H,d];
+    mask [t,s] bool (True = attend). Returns (o_unnorm [B,t,H,d],
+    m [B,t,H] block max, l [B,t,H] block sum)."""
+    s = jnp.einsum("bthd,bshd->bhts", q, k) * scale
+    s = jnp.where(mask[None, None], s, -jnp.inf)
+    m = jnp.max(s, axis=-1)  # [B,H,t]
+    # guard fully-masked rows: exp(-inf - -inf) -> exp(0); zero them via l
+    safe_m = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(s - safe_m[..., None])
+    p = jnp.where(mask[None, None], p, 0.0)
+    l = jnp.sum(p, axis=-1)  # [B,H,t]
+    o = jnp.einsum("bhts,bshd->bthd", p, v)
+    return o, jnp.swapaxes(m, 1, 2), jnp.swapaxes(l, 1, 2)  # m,l -> [B,t,H]
+
+
+def _merge(o1, m1, l1, o2, m2, l2):
+    """Combine two online-softmax partial results."""
+    m = jnp.maximum(m1, m2)
+    safe = lambda mm: jnp.where(jnp.isfinite(mm), mm, 0.0)
+    a1 = jnp.exp(safe(m1) - safe(m))
+    a1 = jnp.where(jnp.isfinite(m1), a1, 0.0)
+    a2 = jnp.exp(safe(m2) - safe(m))
+    a2 = jnp.where(jnp.isfinite(m2), a2, 0.0)
+    o = o1 * a1[..., None] + o2 * a2[..., None]
+    l = l1 * a1 + l2 * a2
+    return o, m, l
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    axis: str = "sp",
+    causal: bool = True,
+) -> jnp.ndarray:
+    """Global causal attention with seq-sharded q/k/v [B, T, H, d]
+    (T divided over ``axis``). Returns [B, T, H, d] with the same
+    sharding. Non-sp mesh axes pass through untouched (batch may be
+    dp/fsdp-sharded on dim 0)."""
+    n = mesh.shape[axis]
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    other = tuple(a for a in mesh.axis_names if a != axis)
+    # batch dim keeps whatever data-axis sharding it has
+    bspec = P(tuple(a for a in other if a in ("dp", "fsdp")) or None, axis, None, None)
+
+    def local(q, k, v):
+        out_dtype = q.dtype
+        # f32 accumulation: the online-softmax carry (o, m, l) compounds
+        # over ring steps; bf16 carries drift ~1% at long T
+        q, k, v = (x.astype(jnp.float32) for x in (q, k, v))
+        t = q.shape[1]
+        my = jax.lax.axis_index(axis)
+
+        def step(i, carry):
+            o, m, l, kk, vv = carry
+            # kk/vv originated on ring position (my - i) mod n
+            src = (my - i) % n
+            if causal:
+                # full block if src < my; diagonal block causal; else empty
+                base = jnp.arange(t)[:, None] >= jnp.arange(t)[None, :]
+                mask = jnp.where(
+                    src == my,
+                    base,
+                    jnp.where(src < my, jnp.ones((t, t), bool), jnp.zeros((t, t), bool)),
+                )
+            else:
+                mask = jnp.ones((t, t), bool)
+            bo, bm, bl = _block_attn(q, kk, vv, scale, mask)
+            o, m, l = _merge(o, m, l, bo, bm, bl)
+            # rotate K/V to the next ring position (ICI neighbor exchange)
+            perm = [(j, (j + 1) % n) for j in range(n)]
+            kk = jax.lax.ppermute(kk, axis, perm)
+            vv = jax.lax.ppermute(vv, axis, perm)
+            return o, m, l, kk, vv
+
+        b, _, h, d = q.shape
+        o0 = jnp.zeros_like(q)
+        m0 = jnp.full((b, t, h), -jnp.inf, q.dtype)
+        l0 = jnp.zeros((b, t, h), q.dtype)
+        o, m, l, _, _ = jax.lax.fori_loop(0, n, step, (o0, m0, l0, k, v))
+        return (o / jnp.maximum(l, 1e-20)[..., None]).astype(out_dtype)
+
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(bspec, bspec, bspec),
+        out_specs=bspec,
+        check_rep=False,
+    )(q, k, v)
+
+
+def reference_attention(q, k, v, causal: bool = True) -> jnp.ndarray:
+    """Unsharded attention, the correctness oracle for the ring."""
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum("bthd,bshd->bhts", q, k) * scale
+    if causal:
+        t = q.shape[1]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhts,bshd->bthd", p, v)
