@@ -3,28 +3,18 @@
 Stands in for a multi-chip TPU slice (SURVEY §4: multi-node testing
 without a cluster). The driver separately dry-runs the multi-chip path
 via __graft_entry__.dryrun_multichip; bench.py alone uses the real chip.
-
-Note: the TPU plugin may already be registered by a sitecustomize at
-interpreter start, so env vars alone are too late — jax.config wins.
 """
 
 import os
 import sys
 
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ.setdefault("JAX_ENABLE_X64", "0")
-
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from edl_tpu.utils.platform import force_virtual_cpu  # noqa: E402
+
+force_virtual_cpu(8)
+
+import jax  # noqa: E402
 import pytest  # noqa: E402
 
 
