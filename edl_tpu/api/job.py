@@ -263,11 +263,67 @@ class TrainingJob:
             spec=spec,
         )
 
+    def to_dict(self) -> dict:
+        """Canonical manifest mapping; inverse of from_dict (legacy
+        reference-era aliases are normalized away)."""
+        s = self.spec
+        worker: dict = {
+            "min_replicas": s.worker.min_replicas,
+            "max_replicas": s.worker.max_replicas,
+        }
+        if s.worker.entrypoint:
+            worker["entrypoint"] = s.worker.entrypoint
+        if s.worker.workspace:
+            worker["workspace"] = s.worker.workspace
+        if s.worker.resources.to_dict():
+            worker["resources"] = s.worker.resources.to_dict()
+        spec: dict = {"worker": worker}
+        if s.image:
+            spec["image"] = s.image
+        if s.host_network:
+            spec["host_network"] = True
+        if s.port:
+            spec["port"] = s.port
+        if s.ports_num:
+            spec["ports_num"] = s.ports_num
+        if s.fault_tolerant:
+            spec["fault_tolerant"] = True
+        if s.passes:
+            spec["passes"] = s.passes
+        if s.accelerator_type:
+            spec["accelerator_type"] = s.accelerator_type
+        if s.node_selector:
+            spec["node_selector"] = dict(s.node_selector)
+        mesh = {k: v for k, v in s.mesh.axis_sizes().items()}
+        if mesh:
+            spec["mesh"] = mesh
+        master: dict = {}
+        if s.master.coordinator_endpoint:
+            master["coordinator_endpoint"] = s.master.coordinator_endpoint
+        if s.master.resources.to_dict():
+            master["resources"] = s.master.resources.to_dict()
+        if master:
+            spec["master"] = master
+        if s.pserver.min_replicas or s.pserver.max_replicas:
+            spec["pserver"] = {
+                "min_replicas": s.pserver.min_replicas,
+                "max_replicas": s.pserver.max_replicas,
+            }
+        meta: dict = {"name": self.name, "namespace": self.namespace}
+        if self.labels:
+            meta["labels"] = dict(self.labels)
+        return {"metadata": meta, "spec": spec}
+
     @classmethod
     def from_yaml(cls, text: str) -> "TrainingJob":
         if not _HAVE_YAML:  # pragma: no cover
             raise RuntimeError("pyyaml unavailable")
-        return cls.from_dict(yaml.safe_load(text))
+        data = yaml.safe_load(text)
+        if not isinstance(data, dict):
+            raise ValueError(
+                f"manifest must be a YAML mapping, got {type(data).__name__}"
+            )
+        return cls.from_dict(data)
 
     @classmethod
     def from_yaml_file(cls, path: str) -> "TrainingJob":

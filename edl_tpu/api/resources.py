@@ -113,6 +113,17 @@ class ResourceSpec:
     def scaled(self, n: int) -> "ResourceSpec":
         return ResourceSpec(self.cpu_milli * n, self.mem_mega * n, self.tpu_chips * n)
 
+    def to_dict(self) -> Dict[str, Union[str, int]]:
+        """Canonical-quantity mapping; inverse of parse (zeros omitted)."""
+        out: Dict[str, Union[str, int]] = {}
+        if self.cpu_milli:
+            out["cpu"] = f"{self.cpu_milli}m"
+        if self.mem_mega:
+            out["memory"] = f"{self.mem_mega}M"
+        if self.tpu_chips:
+            out["tpu"] = self.tpu_chips
+        return out
+
 
 @dataclass
 class ResourceRequirements:
@@ -130,6 +141,14 @@ class ResourceRequirements:
             requests=ResourceSpec.parse(d.get("requests")),
             limits=ResourceSpec.parse(d.get("limits")),
         )
+
+    def to_dict(self) -> Dict[str, Dict]:
+        out: Dict[str, Dict] = {}
+        if self.requests.to_dict():
+            out["requests"] = self.requests.to_dict()
+        if self.limits.to_dict():
+            out["limits"] = self.limits.to_dict()
+        return out
 
 
 def add_resource_list(dst: Dict[str, float], src: Mapping[str, float]) -> None:

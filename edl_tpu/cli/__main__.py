@@ -1,3 +1,5 @@
+import sys
+
 from edl_tpu.cli.main import main
 
-__all__ = ["main"]
+sys.exit(main())

@@ -1,0 +1,336 @@
+"""``edl`` command-line interface.
+
+Port of the reference's daemon entry (reference: cmd/edl/edl.go:16-51 —
+flags, client construction, Controller.Run) plus the kubectl-side job
+verbs its docs drive by hand (reference: doc/usage.md "Submit the
+training job" / "Check the job status"). One binary, subcommands:
+
+    edl controller --store DIR [--hosts N --chips-per-host C ...]
+    edl submit manifest.yaml --store DIR
+    edl delete NAME --store DIR
+    edl list --store DIR
+    edl status NAME --store DIR
+    edl monitor --store DIR [--interval S]
+    edl validate manifest.yaml
+
+The controller daemon and the other verbs meet at a JobStore spool
+directory (the API-server stand-in; see cli/store.py). The daemon runs
+the control plane over a Cluster backend — the built-in backend is the
+synthetic in-memory fleet (cluster/fake.py); a real deployment
+substitutes a backend implementing cluster.base.Cluster.
+
+This module must stay importable without JAX devices: it may not import
+jax (directly or transitively) at module scope.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Optional
+
+from edl_tpu.api.job import TrainingJob
+from edl_tpu.api.parser import JobParser
+from edl_tpu.cli.store import JobStore
+from edl_tpu.utils import logging as edl_logging
+from edl_tpu.utils.logging import kv_logger
+
+log = kv_logger("cli")
+
+
+# ---------------------------------------------------------------------------
+# controller daemon
+# ---------------------------------------------------------------------------
+
+
+def _build_cluster(args):
+    from edl_tpu.cluster.fake import FakeCluster, FakeHost
+
+    hosts = [
+        FakeHost(
+            name=f"host{i}",
+            cpu_milli=args.host_cpu_milli,
+            mem_mega=args.host_mem_mega,
+            chips=args.chips_per_host,
+        )
+        for i in range(args.hosts)
+    ]
+    return FakeCluster(hosts=hosts)
+
+
+def _job_status_record(cluster, job: TrainingJob) -> dict:
+    total, running, pending = cluster.job_pods(job)
+    st = job.status
+    return {
+        "name": job.name,
+        "namespace": job.namespace,
+        "phase": str(st.phase.value),
+        "reason": st.reason,
+        "parallelism": st.parallelism,
+        "total": total,
+        "running": running,
+        "pending": pending,
+        "reshard_count": st.reshard_count,
+        "last_reshard_stall_s": st.last_reshard_stall_s,
+        "min_replicas": job.spec.worker.min_replicas,
+        "max_replicas": job.spec.worker.max_replicas,
+        "chips_per_worker": job.chips_per_worker(),
+    }
+
+
+def run_controller(args) -> int:
+    """The daemon main loop (reference: Controller.Run pkg/controller.go:64-76
+    + the autoscaler 5 s ticker pkg/autoscaler.go:451-485), run
+    synchronously per tick: sync desired state from the store, let the
+    fake pod controller reconcile, autoscale, step the updaters, publish
+    observed state back to the store."""
+    from edl_tpu.controller.controller import Controller
+
+    store = JobStore(args.store)
+    cluster = _build_cluster(args)
+    controller = Controller(cluster, max_load_desired=args.max_load_desired)
+    parser = JobParser()
+    known = set()
+
+    log.info(
+        "controller started",
+        store=args.store,
+        hosts=args.hosts,
+        chips_per_host=args.chips_per_host,
+        max_load_desired=args.max_load_desired,
+    )
+
+    i = 0
+    while args.iterations is None or i < args.iterations:
+        # 1. desired-state sync (the informer-watch analog)
+        desired = set(store.list_keys())
+        for ns, name in sorted(desired - known):
+            job = store.load(ns, name)
+            if job is None:
+                continue
+            try:
+                parser.validate(job)
+            except ValueError as e:
+                log.error("rejecting job", job=name, err=str(e))
+                store.write_status(
+                    ns, name, {"name": name, "namespace": ns,
+                               "phase": "failed", "reason": f"validation: {e}"}
+                )
+                known.add((ns, name))
+                continue
+            cluster.submit_job(job)
+            known.add((ns, name))
+        for ns, name in sorted(known - desired):
+            try:
+                cluster.delete_job(ns, name)
+            except KeyError:
+                pass
+            store.clear_status(ns, name)
+            known.discard((ns, name))
+
+        # 2. advance the world + control loops
+        cluster.reconcile()
+        controller.autoscaler.tick()
+        controller.step()
+
+        # 3. publish observed state (and clear statuses orphaned by jobs
+        # deleted while the daemon was down)
+        for ns, name in set(store.list_statuses()) - desired:
+            store.clear_status(ns, name)
+        for job in cluster.list_jobs():
+            store.write_status(job.namespace, job.name, _job_status_record(cluster, job))
+        r = cluster.inquiry_resource()
+        store.write_cluster(
+            {
+                "ts": time.time(),
+                "chip_total": r.chip_total,
+                "chip_request": r.chip_request,
+                "cpu_total_milli": r.cpu_total_milli,
+                "cpu_request_milli": r.cpu_request_milli,
+                "mem_total_mega": r.mem_total_mega,
+                "mem_request_mega": r.mem_request_mega,
+            }
+        )
+
+        i += 1
+        if args.iterations is not None and i >= args.iterations:
+            break
+        time.sleep(args.tick_s)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# job verbs
+# ---------------------------------------------------------------------------
+
+
+def run_submit(args) -> int:
+    job = TrainingJob.from_yaml_file(args.manifest)
+    if args.name:
+        job.name = args.name
+    JobParser().validate(job)  # reject before spooling, like apiserver admission
+    store = JobStore(args.store)
+    store.submit(job)
+    print(f"trainingjob {job.namespace}/{job.name} submitted")
+    return 0
+
+
+def run_delete(args) -> int:
+    store = JobStore(args.store)
+    if store.delete(args.namespace, args.name):
+        print(f"trainingjob {args.namespace}/{args.name} deleted")
+        return 0
+    print(f"trainingjob {args.namespace}/{args.name} not found", file=sys.stderr)
+    return 1
+
+
+def run_list(args) -> int:
+    store = JobStore(args.store)
+    statuses = store.list_statuses()
+    rows = [("NAMESPACE", "NAME", "PHASE", "WORKERS", "TARGET", "RANGE", "RESHARDS")]
+    for ns, name in store.list_keys():
+        st = statuses.get((ns, name), {})
+        job = store.load(ns, name)
+        rng = (
+            f"{job.spec.worker.min_replicas}-{job.spec.worker.max_replicas}"
+            if job
+            else "?"
+        )
+        rows.append(
+            (
+                ns,
+                name,
+                st.get("phase", "none"),
+                str(st.get("running", 0)),
+                str(st.get("parallelism", 0)),
+                rng,
+                str(st.get("reshard_count", 0)),
+            )
+        )
+    widths = [max(len(r[c]) for r in rows) for c in range(len(rows[0]))]
+    for r in rows:
+        print("  ".join(v.ljust(w) for v, w in zip(r, widths)).rstrip())
+    return 0
+
+
+def run_status(args) -> int:
+    store = JobStore(args.store)
+    st = store.read_status(args.namespace, args.name)
+    if st is None:
+        print(f"no status for {args.namespace}/{args.name}", file=sys.stderr)
+        return 1
+    print(json.dumps(st, indent=2))
+    return 0
+
+
+def run_monitor(args) -> int:
+    from edl_tpu.monitor.collector import Collector, StoreSource
+
+    store = JobStore(args.store)
+    Collector(StoreSource(store), interval_s=args.interval).run(n_polls=args.polls)
+    return 0
+
+
+def run_validate(args) -> int:
+    try:
+        job = TrainingJob.from_yaml_file(args.manifest)
+        JobParser().validate(job)
+    except ValueError as e:
+        print(f"INVALID: {e}", file=sys.stderr)
+        return 1
+    print(
+        f"valid: {job.namespace}/{job.name} "
+        f"workers={job.spec.worker.min_replicas}-{job.spec.worker.max_replicas} "
+        f"chips_per_worker={job.chips_per_worker()} elastic={job.elastic()}"
+    )
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# argument parsing
+# ---------------------------------------------------------------------------
+
+
+def _add_store(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--store", required=True, help="job store (spool) directory")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="edl", description=__doc__.split("\n")[0])
+    p.add_argument(
+        "--log-level",
+        default="info",
+        choices=["debug", "info", "warn", "error"],
+        help="reference: -log_level cmd/edl/edl.go:18",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    c = sub.add_parser("controller", help="run the controller daemon")
+    _add_store(c)
+    c.add_argument(
+        "--max-load-desired",
+        type=float,
+        default=0.97,
+        help="keep cluster load under this fraction "
+        "(reference: -max_load_desired cmd/edl/edl.go:19)",
+    )
+    c.add_argument("--hosts", type=int, default=4, help="synthetic fleet: host count")
+    c.add_argument("--chips-per-host", type=int, default=8)
+    c.add_argument("--host-cpu-milli", type=int, default=96_000)
+    c.add_argument("--host-mem-mega", type=int, default=393_216)
+    c.add_argument(
+        "--tick-s",
+        type=float,
+        default=5.0,
+        help="control period (reference: pkg/autoscaler.go:31)",
+    )
+    c.add_argument(
+        "--iterations", type=int, default=None, help="stop after N ticks (testing)"
+    )
+    c.set_defaults(fn=run_controller)
+
+    s = sub.add_parser("submit", help="submit a TrainingJob manifest")
+    s.add_argument("manifest")
+    s.add_argument("--name", default=None, help="override metadata.name")
+    _add_store(s)
+    s.set_defaults(fn=run_submit)
+
+    d = sub.add_parser("delete", help="delete a submitted job")
+    d.add_argument("name")
+    d.add_argument("--namespace", default="default")
+    _add_store(d)
+    d.set_defaults(fn=run_delete)
+
+    ls = sub.add_parser("list", help="list jobs and their observed state")
+    _add_store(ls)
+    ls.set_defaults(fn=run_list)
+
+    st = sub.add_parser("status", help="print one job's observed status")
+    st.add_argument("name")
+    st.add_argument("--namespace", default="default")
+    _add_store(st)
+    st.set_defaults(fn=run_status)
+
+    m = sub.add_parser("monitor", help="poll and print fleet state (collector)")
+    _add_store(m)
+    m.add_argument("--interval", type=float, default=10.0)
+    m.add_argument("--polls", type=int, default=None, help="stop after N polls")
+    m.set_defaults(fn=run_monitor)
+
+    v = sub.add_parser("validate", help="parse + validate a manifest")
+    v.add_argument("manifest")
+    v.set_defaults(fn=run_validate)
+
+    return p
+
+
+def main(argv: Optional[list] = None) -> int:
+    args = build_parser().parse_args(argv)
+    edl_logging.configure(level=args.log_level)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

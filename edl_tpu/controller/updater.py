@@ -49,6 +49,11 @@ class JobUpdater:
         self.warnings: List[str] = []
         self._create_deadline: Optional[float] = None
         self._released = False
+        # True while an in-process runtime (runtime/local.py) drives this
+        # job and will report reshard completion itself; when False the
+        # control plane infers completion from pod convergence.
+        self.runtime_attached = False
+        self._scaling_since: Optional[float] = None
 
     # -- phase helpers -----------------------------------------------------
 
@@ -140,6 +145,18 @@ class JobUpdater:
         st.worker.failed = group.failed
         st.parallelism = group.parallelism
 
+        # Without an attached runtime to call on_reshard_done, the control
+        # plane marks a rescale complete once the pod set converges on the
+        # new target (stall then measures pod churn, not array resharding).
+        if (
+            self.phase == JobPhase.SCALING
+            and not self.runtime_attached
+            and group.parallelism > 0
+            and group.active == group.parallelism
+        ):
+            since = self._scaling_since
+            self.on_reshard_done(0.0 if since is None else time.monotonic() - since)
+
         if self.job.spec.fault_tolerant:
             # FT jobs fail only when ALL workers are dead with none
             # succeeded (reference :361-370 compares cumulative Failed
@@ -163,10 +180,12 @@ class JobUpdater:
         if self.phase == JobPhase.RUNNING:
             self._set_phase(JobPhase.SCALING, f"resharding to {new_parallelism}")
             self.job.status.reshard_count += 1
+            self._scaling_since = time.monotonic()
 
     def on_reshard_done(self, stall_s: float) -> None:
         if self.phase == JobPhase.SCALING:
             self.job.status.last_reshard_stall_s = stall_s
+            self._scaling_since = None
             self._set_phase(JobPhase.RUNNING)
 
     def release_resources(self) -> None:

@@ -1,0 +1,8 @@
+from edl_tpu.monitor.collector import (
+    ClusterSource,
+    Collector,
+    MonitorSample,
+    StoreSource,
+)
+
+__all__ = ["ClusterSource", "Collector", "MonitorSample", "StoreSource"]

@@ -1,0 +1,171 @@
+"""Cluster monitor — the user-facing observability surface.
+
+Port of the reference's demo monitor (reference:
+example/fit_a_line/collector.py:51-226), which polls the cluster every
+10 s and prints SUBMITTED-JOBS / PENDING-JOBS / RUNNING-TRAINERS /
+CPU-UTILS. Here the census adds TPU-chip utilization (the metric that
+matters on a chip-exclusive fleet) and reshard observability
+(count + last stall seconds — the BASELINE.md north-star metric).
+
+Two sources:
+  * ClusterSource — in-process, reads a live Cluster backend (and its
+    jobs' statuses), for tests and single-process demos;
+  * StoreSource  — cross-process, reads the JobStore status records the
+    controller daemon writes (the collector's kubectl-config analog).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from edl_tpu.utils.logging import kv_logger
+
+log = kv_logger("monitor")
+
+
+@dataclass
+class MonitorSample:
+    """One poll of the fleet (reference: collector.py main loop :215-226)."""
+
+    ts: float = 0.0
+    submitted_jobs: List[str] = field(default_factory=list)
+    pending_jobs: List[str] = field(default_factory=list)
+    running_workers: Dict[str, int] = field(default_factory=dict)
+    parallelism: Dict[str, int] = field(default_factory=dict)
+    phases: Dict[str, str] = field(default_factory=dict)
+    reshards: Dict[str, int] = field(default_factory=dict)
+    last_stall_s: Dict[str, float] = field(default_factory=dict)
+    cpu_total_milli: int = 0
+    cpu_request_milli: int = 0
+    chip_total: int = 0
+    chip_request: int = 0
+
+    @property
+    def cpu_util(self) -> float:
+        """reference: cpu_utils collector.py:156-171."""
+        if self.cpu_total_milli <= 0:
+            return 0.0
+        return 100.0 * self.cpu_request_milli / self.cpu_total_milli
+
+    @property
+    def chip_util(self) -> float:
+        if self.chip_total <= 0:
+            return 0.0
+        return 100.0 * self.chip_request / self.chip_total
+
+    def render(self) -> str:
+        """Text block in the reference collector's table style."""
+        lines = [
+            f"SUBMITTED-JOBS: {len(self.submitted_jobs)}",
+            f"PENDING-JOBS: {len(self.pending_jobs)}"
+            + (f" ({', '.join(self.pending_jobs)})" if self.pending_jobs else ""),
+            "RUNNING-TRAINERS:",
+        ]
+        for name in self.submitted_jobs:
+            n = self.running_workers.get(name, 0)
+            extras = []
+            if name in self.parallelism:
+                extras.append(f"target={self.parallelism[name]}")
+            if name in self.phases:
+                extras.append(f"phase={self.phases[name]}")
+            if self.reshards.get(name):
+                extras.append(
+                    f"reshards={self.reshards[name]}"
+                    f" last_stall={self.last_stall_s.get(name, 0.0):.2f}s"
+                )
+            suffix = (" [" + " ".join(extras) + "]") if extras else ""
+            lines.append(f"  {name}: {n}{suffix}")
+        lines.append(f"CPU-UTILS: {self.cpu_util:.2f}%")
+        lines.append(
+            f"CHIP-UTILS: {self.chip_util:.2f}% "
+            f"({self.chip_request}/{self.chip_total})"
+        )
+        return "\n".join(lines)
+
+
+class ClusterSource:
+    """Sample a live Cluster backend in-process."""
+
+    def __init__(self, cluster):
+        self.cluster = cluster
+
+    def sample(self) -> MonitorSample:
+        s = MonitorSample(ts=time.time())
+        r = self.cluster.inquiry_resource()
+        s.cpu_total_milli = r.cpu_total_milli
+        s.cpu_request_milli = r.cpu_request_milli
+        s.chip_total = r.chip_total
+        s.chip_request = r.chip_request
+        for job in self.cluster.list_jobs():
+            s.submitted_jobs.append(job.name)
+            total, running, pending = self.cluster.job_pods(job)
+            s.running_workers[job.name] = running
+            # reference: get_pending_jobs collector.py:194-213 — a job is
+            # pending while it has waiting pods and nothing running yet.
+            if pending > 0 and running == 0:
+                s.pending_jobs.append(job.name)
+            s.parallelism[job.name] = job.status.parallelism
+            s.phases[job.name] = str(job.status.phase.value)
+            s.reshards[job.name] = job.status.reshard_count
+            s.last_stall_s[job.name] = job.status.last_reshard_stall_s
+        return s
+
+
+class StoreSource:
+    """Sample the JobStore statuses a controller daemon writes."""
+
+    def __init__(self, store):
+        self.store = store
+
+    def sample(self) -> MonitorSample:
+        s = MonitorSample(ts=time.time())
+        census = self.store.read_cluster() or {}
+        s.cpu_total_milli = census.get("cpu_total_milli", 0)
+        s.cpu_request_milli = census.get("cpu_request_milli", 0)
+        s.chip_total = census.get("chip_total", 0)
+        s.chip_request = census.get("chip_request", 0)
+        statuses = self.store.list_statuses()
+        for ns, name in self.store.list_keys():
+            s.submitted_jobs.append(name)
+            st = statuses.get((ns, name), {})
+            running = st.get("running", 0)
+            s.running_workers[name] = running
+            if st.get("pending", 0) > 0 and running == 0:
+                s.pending_jobs.append(name)
+            s.parallelism[name] = st.get("parallelism", 0)
+            s.phases[name] = st.get("phase", "none")
+            s.reshards[name] = st.get("reshard_count", 0)
+            s.last_stall_s[name] = st.get("last_reshard_stall_s", 0.0)
+        return s
+
+
+class Collector:
+    """Poll a source and print samples (reference: Collector
+    collector.py:51 + the 10 s main loop :215-226)."""
+
+    def __init__(self, source, interval_s: float = 10.0, out=None):
+        self.source = source
+        self.interval_s = interval_s
+        self.out = out
+        self.samples: List[MonitorSample] = []
+
+    def poll(self) -> MonitorSample:
+        s = self.source.sample()
+        self.samples.append(s)
+        return s
+
+    def run(self, n_polls: Optional[int] = None) -> None:
+        import sys
+
+        out = self.out or sys.stdout
+        i = 0
+        while n_polls is None or i < n_polls:
+            s = self.poll()
+            print(time.strftime("---- %H:%M:%S", time.localtime(s.ts)), file=out)
+            print(s.render(), file=out, flush=True)
+            i += 1
+            if n_polls is not None and i >= n_polls:
+                break
+            time.sleep(self.interval_s)

@@ -60,6 +60,9 @@ class LocalJobRunner:
         if hasattr(cluster, "scale_listeners"):
             cluster.scale_listeners.append(self._on_scale)
             self._attached = True
+        u = controller.updaters.get(job.name)
+        if u is not None:
+            u.runtime_attached = True  # this runner reports reshard stalls
         self.trainer.start(init_params, n_workers=group.parallelism)
 
     def detach(self) -> None:
@@ -71,6 +74,9 @@ class LocalJobRunner:
             except ValueError:
                 pass
             self._attached = False
+        u = self.controller.updaters.get(self.job.name)
+        if u is not None:
+            u.runtime_attached = False
 
     def _on_scale(self, job_name: str, parallelism: int) -> None:
         if job_name == self.job.name:
