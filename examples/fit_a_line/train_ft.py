@@ -1,0 +1,121 @@
+"""Elastic fault-tolerant fit_a_line — the reference's flagship demo.
+
+Port of reference example/fit_a_line/train_ft.py:33-114: an elastic
+trainer that pulls work from a lease-based task queue so workers can
+come and go mid-pass, retargeted by the autoscaler. TPU-native shape:
+the pserver/etcd runtime is replaced by an in-mesh data-parallel
+trainer that reshards in place on each scale event (zero restarts).
+
+Run (hardware-free, 8-device virtual CPU mesh):
+    python examples/fit_a_line/train_ft.py
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from edl_tpu.utils.platform import force_virtual_cpu  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--samples", type=int, default=4096)
+    ap.add_argument("--chunk", type=int, default=128)
+    ap.add_argument("--kill-one-worker", action="store_true",
+                    help="fail a worker mid-pass to demo fault tolerance")
+    args = ap.parse_args()
+
+    force_virtual_cpu(args.devices)
+
+    import jax
+    import numpy as np
+    import optax
+
+    from edl_tpu.api.job import JobPhase, TrainingJob
+    from edl_tpu.cluster.fake import FakeCluster, FakeHost
+    from edl_tpu.controller.controller import Controller
+    from edl_tpu.models import linreg
+    from edl_tpu.monitor.collector import ClusterSource, Collector
+    from edl_tpu.runtime.data import ElasticDataQueue, QueueBatcher
+    from edl_tpu.runtime.local import LocalJobRunner
+
+    # Synthetic fleet: one chip per host so the elastic range is visible.
+    cluster = FakeCluster(
+        hosts=[FakeHost(f"h{i}", 8000, 16000, 1) for i in range(args.devices)]
+    )
+    ctl = Controller(cluster, max_load_desired=1.0)
+
+    job = TrainingJob.from_yaml_file(
+        os.path.join(os.path.dirname(__file__), "job.yaml")
+    )
+    cluster.submit_job(job)
+    ctl.step()
+    assert ctl.phase_of(job.name) == JobPhase.RUNNING
+    print(f"submitted {job.name}: workers start at {job.status.parallelism}")
+
+    # The master-task-queue analog: chunked sample leases with timeout
+    # redelivery (reference: cloud_reader train_ft.py:111-114).
+    queue = ElasticDataQueue(
+        n_samples=args.samples, chunk_size=args.chunk, passes=job.spec.passes
+    )
+    x, y = linreg.synthetic_dataset(args.samples)
+    batcher = QueueBatcher(
+        queue, lambda t: {"x": x[t.start : t.end], "y": y[t.start : t.end]}
+    )
+
+    def data_fn(bs):
+        b = batcher.next_batch(bs)
+        if b is None:
+            return {"x": x[:bs], "y": y[:bs]}
+        if b["x"].shape[0] < bs:
+            b = {k: np.resize(v, (bs,) + v.shape[1:]) for k, v in b.items()}
+        return b
+
+    runner = LocalJobRunner(
+        ctl,
+        job,
+        linreg.loss_fn,
+        optax.sgd(0.05),
+        linreg.init_params(jax.random.PRNGKey(0)),
+        per_chip_batch=16,
+    )
+    runner.trainer.train_steps(data_fn, 3)
+
+    # Idle fleet -> the autoscaler grows the job; training reshards
+    # in place at the next step boundary.
+    ctl.autoscaler.tick()
+    runner.trainer.train_steps(data_fn, 3)  # reshard up happens here
+
+    if args.kill_one_worker:
+        # A host dies mid-pass: the runtime reshards down to the live
+        # membership and the dead worker's leased chunks are redelivered
+        # (reference: master task queue redispatch, docker/paddle_k8s:28-31).
+        victim = next(
+            p for p in cluster.pods.values()
+            if p.role == "worker" and p.host is not None
+        )
+        print(f"host {victim.host} dies (taking worker pod {victim.name})")
+        cluster.remove_host(victim.host)
+        queue.release_worker("w-dead")
+        cluster.reconcile()
+
+    report = runner.run(data_fn, queue=queue)
+
+    sample = ClusterSource(cluster).sample()
+    print(sample.render())
+    print(
+        f"done: phase={ctl.phase_of(job.name).value} "
+        f"steps={int(runner.trainer.state.step)} "
+        f"final_loss={report.losses[-1]:.4f} "
+        f"reshards={[f'{e.from_workers}->{e.to_workers} {e.stall_s * 1e3:.0f}ms' for e in report.reshards]}"
+    )
+    assert ctl.phase_of(job.name) == JobPhase.SUCCEEDED
+    assert report.losses[-1] < report.losses[0]
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

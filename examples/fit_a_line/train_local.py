@@ -1,0 +1,60 @@
+"""Local (non-distributed) fit_a_line baseline.
+
+Port of reference example/fit_a_line/train_local.py:41-106: the same
+model and data as train_ft.py with no control plane — one device, a
+plain jitted SGD loop, parameters saved per pass.
+
+Run: python examples/fit_a_line/train_local.py
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--samples", type=int, default=4096)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--passes", type=int, default=2)
+    ap.add_argument("--save-dir", default=None,
+                    help="save params per pass (reference: save_parameter_to_tar)")
+    args = ap.parse_args()
+
+    import jax
+    import numpy as np
+    import optax
+
+    from edl_tpu.models import linreg
+
+    x, y = linreg.synthetic_dataset(args.samples)
+    params = linreg.init_params(jax.random.PRNGKey(0))
+    tx = optax.sgd(0.05)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(linreg.loss_fn)(params, batch)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    n_batches = args.samples // args.batch
+    for p in range(args.passes):
+        loss = None
+        for i in range(n_batches):
+            lo = i * args.batch
+            batch = {"x": x[lo : lo + args.batch], "y": y[lo : lo + args.batch]}
+            params, opt_state, loss = step(params, opt_state, batch)
+        print(f"pass {p}: loss={float(loss):.6f}")
+        if args.save_dir:
+            os.makedirs(args.save_dir, exist_ok=True)
+            path = os.path.join(args.save_dir, f"pass-{p}.npz")
+            np.savez(path, **{k: np.asarray(v) for k, v in params.items()})
+            print(f"  saved {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
