@@ -1,0 +1,185 @@
+"""BERT-class bidirectional encoder — masked-language-model pretraining.
+
+The "BERT-class elastic DP" workload of the build plan (SURVEY §7.8);
+no reference analog (its models are 2018-era CTR/word2vec, SURVEY §5).
+Same TPU-first construction as models/llama.py: scan-stacked layers
+(O(1) compile in depth), explicit TP×FSDP partition specs, bfloat16
+activations over float32 params. Architectural differences from the
+decoder: bidirectional attention (no causal mask), learned positional
+embeddings, LayerNorm with bias, GELU MLP, and an MLM loss computed
+only at masked positions.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from edl_tpu.parallel.mesh import MeshPlan
+
+MASK_TOKEN = 0  # convention: id 0 is [MASK]
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab: int = 30522
+    max_seq: int = 512
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    norm_eps: float = 1e-12
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @classmethod
+    def base(cls) -> "BertConfig":
+        return cls()
+
+    @classmethod
+    def tiny(cls, vocab: int = 256) -> "BertConfig":
+        return cls(
+            vocab=vocab,
+            max_seq=64,
+            d_model=64,
+            n_layers=2,
+            n_heads=4,
+            d_ff=128,
+            dtype=jnp.float32,
+        )
+
+
+def init_params(key: jax.Array, cfg: BertConfig) -> Dict:
+    k = jax.random.split(key, 8)
+    d, h, hd, ff, L = cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.d_ff, cfg.n_layers
+
+    def g(kk, *shape, scale):
+        return jax.random.normal(kk, shape, jnp.float32) * scale
+
+    return {
+        "embed": g(k[0], cfg.vocab, d, scale=0.02),
+        "pos_embed": g(k[1], cfg.max_seq, d, scale=0.02),
+        "layers": {
+            "ln1_g": jnp.ones((L, d), jnp.float32),
+            "ln1_b": jnp.zeros((L, d), jnp.float32),
+            "wqkv": g(k[2], L, d, 3 * h * hd, scale=d**-0.5),
+            "wo": g(k[3], L, h * hd, d, scale=(h * hd) ** -0.5),
+            "ln2_g": jnp.ones((L, d), jnp.float32),
+            "ln2_b": jnp.zeros((L, d), jnp.float32),
+            "w_up": g(k[4], L, d, ff, scale=d**-0.5),
+            "b_up": jnp.zeros((L, ff), jnp.float32),
+            "w_down": g(k[5], L, ff, d, scale=ff**-0.5),
+            "b_down": jnp.zeros((L, d), jnp.float32),
+        },
+        "ln_f_g": jnp.ones((d,), jnp.float32),
+        "ln_f_b": jnp.zeros((d,), jnp.float32),
+        "mlm_head": g(k[6], d, cfg.vocab, scale=d**-0.5),
+    }
+
+
+def param_pspecs(cfg: BertConfig, plan: MeshPlan) -> Dict:
+    """TP on head/ffn width, FSDP on the other large dim (llama layout)."""
+    tp = "tp" if plan.axis_size("tp") > 1 else None
+    fs = "fsdp" if plan.axis_size("fsdp") > 1 else None
+    return {
+        "embed": P(tp, fs),
+        "pos_embed": P(None, fs),
+        "layers": {
+            "ln1_g": P(None, None),
+            "ln1_b": P(None, None),
+            "wqkv": P(None, fs, tp),
+            "wo": P(None, tp, fs),
+            "ln2_g": P(None, None),
+            "ln2_b": P(None, None),
+            "w_up": P(None, fs, tp),
+            "b_up": P(None, tp),
+            "w_down": P(None, tp, fs),
+            "b_down": P(None, None),
+        },
+        "ln_f_g": P(None),
+        "ln_f_b": P(None),
+        "mlm_head": P(fs, tp),
+    }
+
+
+def _layernorm(x, g, b, eps):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mu), axis=-1, keepdims=True)
+    y = (xf - mu) * jax.lax.rsqrt(var + eps)
+    return (y * g + b).astype(x.dtype)
+
+
+def _layer(cfg: BertConfig, x: jnp.ndarray, lp: Dict) -> jnp.ndarray:
+    b, t, d = x.shape
+    h, hd = cfg.n_heads, cfg.head_dim
+    dt = x.dtype
+    a = _layernorm(x, lp["ln1_g"].astype(dt), lp["ln1_b"].astype(dt), cfg.norm_eps)
+    qkv = (a @ lp["wqkv"].astype(dt)).reshape(b, t, 3, h, hd)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    scores = jnp.einsum("bthd,bshd->bhts", q, k) / np.sqrt(hd)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dt)
+    o = jnp.einsum("bhts,bshd->bthd", probs, v).reshape(b, t, h * hd)
+    x = x + o @ lp["wo"].astype(dt)
+    m = _layernorm(x, lp["ln2_g"].astype(dt), lp["ln2_b"].astype(dt), cfg.norm_eps)
+    up = jax.nn.gelu(m @ lp["w_up"].astype(dt) + lp["b_up"].astype(dt))
+    return x + (up @ lp["w_down"].astype(dt) + lp["b_down"].astype(dt))
+
+
+def forward(params: Dict, tokens: jnp.ndarray, cfg: BertConfig) -> jnp.ndarray:
+    """tokens [B, T] int32 → logits [B, T, vocab] (pre-norm encoder)."""
+    t = tokens.shape[1]
+    x = (
+        jnp.take(params["embed"], tokens, axis=0)
+        + params["pos_embed"][None, :t]
+    ).astype(cfg.dtype)
+
+    def body(carry, lp):
+        return _layer(cfg, carry, lp), None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = _layernorm(x, params["ln_f_g"], params["ln_f_b"], cfg.norm_eps)
+    return (x @ params["mlm_head"].astype(cfg.dtype)).astype(jnp.float32)
+
+
+def make_loss_fn(cfg: BertConfig):
+    """MLM cross entropy at masked positions.
+
+    batch = {tokens [B,T] (with MASK_TOKEN holes), targets [B,T]
+    (original ids), mask [B,T] float (1 at masked positions)}.
+    """
+
+    def loss_fn(params, batch):
+        logits = forward(params, batch["tokens"], cfg)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, batch["targets"][..., None], axis=-1)[..., 0]
+        mask = batch["mask"].astype(jnp.float32)
+        return -jnp.sum(ll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+    return loss_fn
+
+
+def synthetic_mlm_batch(
+    rng: np.random.RandomState, batch: int, seq: int, vocab: int,
+    mask_prob: float = 0.15,
+) -> Dict[str, np.ndarray]:
+    """Position-structured token stream (token id cycles with position)
+    so MLM loss is quickly learnable, with ``mask_prob`` of positions
+    replaced by MASK_TOKEN."""
+    pos = np.arange(seq, dtype=np.int32)[None, :]
+    targets = np.broadcast_to((pos % (vocab - 1)) + 1, (batch, seq))
+    mask = rng.rand(batch, seq) < mask_prob
+    tokens = np.where(mask, MASK_TOKEN, targets).astype(np.int32)
+    return {
+        "tokens": tokens,
+        "targets": targets.astype(np.int32),
+        "mask": mask.astype(np.float32),
+    }

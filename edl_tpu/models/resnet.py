@@ -1,0 +1,185 @@
+"""ResNet-class convolutional classifier — the vision elastic-DP workload.
+
+The "ResNet-class elastic DP" entry of the build plan (SURVEY §7.8).
+TPU-first choices: NHWC layout (XLA's native TPU conv layout), GroupNorm
+instead of BatchNorm (stateless → purely functional train step, and no
+cross-replica batch-stat sync on the elastic dp axis), bfloat16 compute.
+Convolutions lower onto the MXU as implicit GEMMs; channel widths are
+multiples of 128 at full size to tile the systolic array.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from edl_tpu.parallel.mesh import MeshPlan
+
+
+@dataclass(frozen=True)
+class ResNetConfig:
+    num_classes: int = 1000
+    widths: Tuple[int, ...] = (256, 512, 1024, 2048)
+    blocks_per_stage: Tuple[int, ...] = (3, 4, 6, 3)  # ResNet-50-ish
+    stem_width: int = 128
+    groups: int = 32  # GroupNorm groups
+    dtype: Any = jnp.bfloat16
+
+    @classmethod
+    def resnet50(cls) -> "ResNetConfig":
+        return cls()
+
+    @classmethod
+    def tiny(cls, num_classes: int = 10) -> "ResNetConfig":
+        return cls(
+            num_classes=num_classes,
+            widths=(16, 32),
+            blocks_per_stage=(1, 1),
+            stem_width=16,
+            groups=4,
+            dtype=jnp.float32,
+        )
+
+
+def _conv_init(key, kh, kw, cin, cout):
+    fan_in = kh * kw * cin
+    return jax.random.normal(key, (kh, kw, cin, cout), jnp.float32) * np.sqrt(
+        2.0 / fan_in
+    )
+
+
+def init_params(key: jax.Array, cfg: ResNetConfig) -> Dict:
+    keys = iter(jax.random.split(key, 4 + 4 * sum(cfg.blocks_per_stage)))
+    params: Dict = {
+        "stem": _conv_init(next(keys), 3, 3, 3, cfg.stem_width),
+        "stem_gn": {"g": jnp.ones((cfg.stem_width,)), "b": jnp.zeros((cfg.stem_width,))},
+        "stages": [],
+    }
+    cin = cfg.stem_width
+    for width, n_blocks in zip(cfg.widths, cfg.blocks_per_stage):
+        stage = []
+        for b in range(n_blocks):
+            blk = {
+                "conv1": _conv_init(next(keys), 3, 3, cin, width),
+                "gn1": {"g": jnp.ones((width,)), "b": jnp.zeros((width,))},
+                "conv2": _conv_init(next(keys), 3, 3, width, width),
+                "gn2": {"g": jnp.ones((width,)), "b": jnp.zeros((width,))},
+            }
+            if cin != width:
+                blk["proj"] = _conv_init(next(keys), 1, 1, cin, width)
+            stage.append(blk)
+            cin = width
+        params["stages"].append(stage)
+    params["head"] = {
+        "w": jax.random.normal(next(keys), (cin, cfg.num_classes), jnp.float32)
+        * np.sqrt(1.0 / cin),
+        "b": jnp.zeros((cfg.num_classes,)),
+    }
+    return params
+
+
+def param_pspecs(cfg: ResNetConfig, plan: MeshPlan) -> Dict:
+    """Conv kernels shard output channels over fsdp (widths are
+    power-of-two multiples); head shards its input dim. Replicated when
+    the axis is absent. Mirrors init_params' cin-tracking loop so the
+    spec tree always matches the param tree."""
+    fs = "fsdp" if plan.axis_size("fsdp") > 1 else None
+
+    def gn_spec():
+        return {"g": P(fs), "b": P(fs)}
+
+    stages = []
+    cin = cfg.stem_width
+    for width, n_blocks in zip(cfg.widths, cfg.blocks_per_stage):
+        stage = []
+        for _ in range(n_blocks):
+            blk = {
+                "conv1": P(None, None, None, fs),
+                "gn1": gn_spec(),
+                "conv2": P(None, None, None, fs),
+                "gn2": gn_spec(),
+            }
+            if cin != width:
+                blk["proj"] = P(None, None, None, fs)
+            stage.append(blk)
+            cin = width
+        stages.append(stage)
+    return {
+        "stem": P(None, None, None, fs),
+        "stem_gn": gn_spec(),
+        "stages": stages,
+        "head": {"w": P(fs, None), "b": P(None)},
+    }
+
+
+def _conv(x, w, stride=1):
+    return jax.lax.conv_general_dilated(
+        x,
+        w.astype(x.dtype),
+        window_strides=(stride, stride),
+        padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _groupnorm(x, g, b, groups, eps=1e-5):
+    n, h, w, c = x.shape
+    xf = x.astype(jnp.float32).reshape(n, h, w, groups, c // groups)
+    mu = jnp.mean(xf, axis=(1, 2, 4), keepdims=True)
+    var = jnp.var(xf, axis=(1, 2, 4), keepdims=True)
+    y = ((xf - mu) * jax.lax.rsqrt(var + eps)).reshape(n, h, w, c)
+    return (y * g + b).astype(x.dtype)
+
+
+def forward(params: Dict, images: jnp.ndarray, cfg: ResNetConfig) -> jnp.ndarray:
+    """images [B, H, W, 3] → logits [B, num_classes]."""
+    x = images.astype(cfg.dtype)
+    x = _conv(x, params["stem"])
+    x = jax.nn.relu(
+        _groupnorm(x, params["stem_gn"]["g"], params["stem_gn"]["b"], cfg.groups)
+    )
+    for si, stage in enumerate(params["stages"]):
+        for bi, blk in enumerate(stage):
+            stride = 2 if bi == 0 and si > 0 else 1
+            y = _conv(x, blk["conv1"], stride=stride)
+            y = jax.nn.relu(_groupnorm(y, blk["gn1"]["g"], blk["gn1"]["b"], cfg.groups))
+            y = _conv(y, blk["conv2"])
+            y = _groupnorm(y, blk["gn2"]["g"], blk["gn2"]["b"], cfg.groups)
+            sc = x
+            if "proj" in blk:
+                sc = _conv(sc, blk["proj"], stride=stride)
+            elif stride != 1:
+                sc = sc[:, ::stride, ::stride]
+            x = jax.nn.relu(y + sc)
+    x = jnp.mean(x, axis=(1, 2))  # global average pool
+    head = params["head"]
+    return (x @ head["w"].astype(x.dtype) + head["b"].astype(x.dtype)).astype(
+        jnp.float32
+    )
+
+
+def make_loss_fn(cfg: ResNetConfig):
+    """Softmax cross entropy; batch = {images [B,H,W,3], label [B]}."""
+
+    def loss_fn(params, batch):
+        logits = forward(params, batch["images"], cfg)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, batch["label"][:, None], axis=-1)[:, 0]
+        return -jnp.mean(ll)
+
+    return loss_fn
+
+
+def synthetic_batch(
+    rng: np.random.RandomState, batch: int, size: int = 32, num_classes: int = 10
+) -> Dict[str, np.ndarray]:
+    """Class-dependent brightness pattern so the loss is learnable."""
+    label = rng.randint(0, num_classes, size=batch, dtype=np.int32)
+    images = rng.rand(batch, size, size, 3).astype(np.float32)
+    images += (label / num_classes)[:, None, None, None]
+    return {"images": images, "label": label}

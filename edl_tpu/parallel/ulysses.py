@@ -1,0 +1,73 @@
+"""Ulysses sequence parallelism — all-to-all head/sequence exchange.
+
+The second long-context strategy (SURVEY §5 lists both: "ring-attention
+over ICI neighbor exchange; Ulysses-style all-to-all within a slice";
+the reference has neither). Inputs arrive sequence-sharded over the
+``sp`` axis; an all-to-all re-shards them over attention heads so every
+device computes *full-sequence* attention for ``H / sp`` heads, and a
+second all-to-all restores sequence sharding. Two collectives per
+attention call (vs one ppermute per ring step) but each device sees the
+whole sequence, so any attention kernel — including the pallas flash
+kernel — drops in unchanged.
+
+Trade-off vs ring attention: Ulysses is bandwidth-cheaper for moderate
+sequence lengths inside one slice (all-to-all rides full ICI bisection),
+while ring attention overlaps compute with neighbor exchange and scales
+past the head-count limit (sp must divide n_heads here).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+# the experimental module still accepts check_rep; jax.shard_map does not
+from jax.experimental.shard_map import shard_map
+
+from edl_tpu.parallel.ring_attention import reference_attention
+
+
+def ulysses_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    axis: str = "sp",
+    causal: bool = True,
+) -> jnp.ndarray:
+    """Attention over sequence-sharded [B, S, H, d] q/k/v.
+
+    S is the *global* sequence length (each device holds S/sp); H must
+    be divisible by the ``axis`` size. Returns output with the same
+    sequence sharding as q.
+    """
+    n = mesh.shape[axis]
+    if q.shape[2] % n:
+        raise ValueError(f"n_heads={q.shape[2]} not divisible by {axis}={n}")
+    # batch dim keeps whatever data-axis sharding it has (as ring_attention)
+    other = tuple(a for a in mesh.axis_names if a != axis)
+    spec = P(tuple(a for a in other if a in ("dp", "fsdp")) or None, axis, None, None)
+
+    def local(q, k, v):
+        # [B, S/n, H, d] --all-to-all--> [B, S, H/n, d]
+        def scatter_heads(x):
+            return jax.lax.all_to_all(
+                x, axis, split_axis=2, concat_axis=1, tiled=True
+            )
+
+        # full-sequence attention on the local head shard (the unsharded
+        # oracle is exactly the right kernel here)
+        o = reference_attention(
+            scatter_heads(q), scatter_heads(k), scatter_heads(v), causal=causal
+        )
+        # [B, S, H/n, d] --all-to-all--> [B, S/n, H, d]
+        return jax.lax.all_to_all(o, axis, split_axis=1, concat_axis=2, tiled=True)
+
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_rep=False,
+    )(q, k, v)
