@@ -87,10 +87,18 @@ def run_controller(args) -> int:
     fake pod controller reconcile, autoscale, step the updaters, publish
     observed state back to the store."""
     from edl_tpu.controller.controller import Controller
+    from edl_tpu.scheduler.autoscaler import Autoscaler
 
     store = JobStore(args.store)
     cluster = _build_cluster(args)
-    controller = Controller(cluster, max_load_desired=args.max_load_desired)
+    controller = Controller(
+        cluster,
+        autoscaler=Autoscaler(
+            cluster,
+            max_load_desired=args.max_load_desired,
+            use_native=not args.no_native_scheduler,
+        ),
+    )
     parser = JobParser()
     known = set()
 
@@ -288,6 +296,11 @@ def build_parser() -> argparse.ArgumentParser:
     )
     c.add_argument(
         "--iterations", type=int, default=None, help="stop after N ticks (testing)"
+    )
+    c.add_argument(
+        "--no-native-scheduler",
+        action="store_true",
+        help="plan in Python instead of the C++ core (native/scheduler)",
     )
     c.set_defaults(fn=run_controller)
 

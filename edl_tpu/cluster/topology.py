@@ -63,6 +63,15 @@ def pow2(n: int) -> bool:
 POLICIES: Dict[str, SlicePolicy] = {"flexible": flexible, "pow2": pow2}
 
 
+def policy_name(policy: SlicePolicy) -> str:
+    """Registry name of a built-in policy, or "" for a custom callable
+    (custom policies are Python-only — the native planner can't run them)."""
+    for name, p in POLICIES.items():
+        if p is policy:
+            return name
+    return ""
+
+
 def next_legal(n: int, direction: int, policy: SlicePolicy, lo: int, hi: int) -> int:
     """Nearest legal count moving from ``n`` by ``direction`` (±1), clamped
     to [lo, hi]. A count outside the range jumps to the range edge first

@@ -189,15 +189,16 @@ def scale_dry_run(
     found = search_assignable_hosts(r, j, step)
     if found is None:
         return 0  # the whole step must fit (reference: :264-267)
-    assigned_hosts = found
 
     # CPU respects the load ceiling; chips scale to full (reference
     # keeps GPU unguarded by maxLoadDesired, :269-278).
     cpu_ok = r.cpu_total_milli * max_load_desired - r.cpu_request_milli >= cpu * step
-    if chips > 0:
-        chips_ok = r.chip_total - r.chip_limit >= chips * step
-        return _account(step if (cpu_ok and chips_ok) else 0)
-    return _account(step if cpu_ok else 0)
+    if chips > 0 and not (r.chip_total - r.chip_limit >= chips * step):
+        return 0
+    if not cpu_ok:
+        return 0
+    assigned_hosts = found  # only account hosts for a step actually taken
+    return _account(step)
 
 
 def scale_all_jobs_dry_run(
@@ -253,6 +254,7 @@ class Autoscaler:
         slice_policy: topology.SlicePolicy = topology.flexible,
         loop_seconds: float = DEFAULT_LOOP_SECONDS,
         rescale_cooldown_s: float = 0.0,
+        use_native: bool = False,
     ):
         # rescale_cooldown_s damps the reference algorithm's fulfillment
         # ping-pong (jobs trading one worker back and forth every tick):
@@ -265,6 +267,9 @@ class Autoscaler:
         self.slice_policy = slice_policy
         self.loop_seconds = loop_seconds
         self.rescale_cooldown_s = rescale_cooldown_s
+        # plan with the C++ core (native/scheduler) when it is buildable
+        # and the policy is a built-in; silently falls back to Python
+        self.use_native = use_native
         self.jobs: Dict[str, JobState] = {}
         self._last_rescale: Dict[str, float] = {}
         self._events: "queue.Queue[Event]" = queue.Queue()
@@ -373,9 +378,19 @@ class Autoscaler:
                 if now - self._last_rescale.get(j.config.name, -1e18)
                 >= self.rescale_cooldown_s
             ]
-        diff = scale_all_jobs_dry_run(
-            candidates, r.copy(), self.max_load_desired, self.slice_policy
-        )
+        diff = None
+        if self.use_native:
+            pname = topology.policy_name(self.slice_policy)
+            if pname:
+                from edl_tpu.scheduler import native as native_sched
+
+                diff = native_sched.plan_native(
+                    candidates, r, self.max_load_desired, pname
+                )
+        if diff is None:
+            diff = scale_all_jobs_dry_run(
+                candidates, r.copy(), self.max_load_desired, self.slice_policy
+            )
         target = {
             name: self.jobs[name].group.parallelism + d
             for name, d in diff.items()

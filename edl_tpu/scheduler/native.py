@@ -1,0 +1,120 @@
+"""ctypes binding for the native (C++) scheduler planning core.
+
+The reference's scheduler runs compiled (Go); here the dry-run fixed
+point has a C++ twin (native/scheduler/sched.cc) kept semantically
+identical to the Python planner in scheduler/autoscaler.py. The
+Autoscaler uses it when available (``use_native=True``) and falls back
+to Python silently — plans are interchangeable by construction
+(cross-checked in tests/test_native_sched.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Dict, List, Optional
+
+from edl_tpu.cluster.resource import ClusterResource
+from edl_tpu.utils.logging import kv_logger
+
+log = kv_logger("sched.native")
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+    "scheduler",
+)
+_LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libedl_sched.so")
+
+_build_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+POLICY_IDS = {"flexible": 0, "pow2": 1}
+
+
+def ensure_native_built() -> bool:
+    if os.path.exists(_LIB_PATH):
+        return True
+    with _build_lock:
+        if os.path.exists(_LIB_PATH):
+            return True
+        try:
+            subprocess.run(
+                ["make", "-C", _NATIVE_DIR],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+            return True
+        except Exception as e:
+            log.warn("native scheduler build failed", error=str(e))
+            return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not ensure_native_built():
+        return None
+    lib = ctypes.CDLL(_LIB_PATH)
+    I64P = ctypes.POINTER(ctypes.c_int64)
+    lib.edl_sched_plan.restype = ctypes.c_int
+    lib.edl_sched_plan.argtypes = (
+        [ctypes.c_int64] + [I64P] * 6          # jobs
+        + [ctypes.c_int64] + [I64P] * 3        # hosts
+        + [ctypes.c_int64] * 6                 # totals
+        + [ctypes.c_double, ctypes.c_int32, I64P]
+    )
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def plan_native(
+    jobs: List,  # List[JobState] (scheduler.autoscaler)
+    r: ClusterResource,
+    max_load_desired: float,
+    policy_name: str = "flexible",
+) -> Optional[Dict[str, int]]:
+    """Plan deltas with the native core; None when unavailable (caller
+    falls back to the Python planner). ``r`` is not mutated."""
+    lib = _load()
+    if lib is None:
+        return None
+    pid = POLICY_IDS.get(policy_name)
+    if pid is None:
+        return None  # custom Python policy: only the Python planner knows it
+
+    n = len(jobs)
+    arr = lambda vals: (ctypes.c_int64 * len(vals))(*vals)
+    job_min = arr([j.config.spec.worker.min_replicas for j in jobs])
+    job_max = arr([j.config.spec.worker.max_replicas for j in jobs])
+    job_par = arr([j.group.parallelism if j.group else 0 for j in jobs])
+    job_chip = arr([j.chips_per_worker() for j in jobs])
+    job_cpu = arr([j.cpu_request_milli() for j in jobs])
+    job_mem = arr([j.mem_request_mega() for j in jobs])
+
+    host_names = sorted(r.hosts.cpu_idle_milli)
+    host_cpu = arr([r.hosts.cpu_idle_milli[h] for h in host_names])
+    host_mem = arr([r.hosts.mem_free_mega.get(h, 0) for h in host_names])
+    host_chip = arr([r.hosts.chips_free.get(h, 0) for h in host_names])
+
+    out = (ctypes.c_int64 * n)()
+    rc = lib.edl_sched_plan(
+        n, job_min, job_max, job_par, job_chip, job_cpu, job_mem,
+        len(host_names), host_cpu, host_mem, host_chip,
+        r.chip_total, r.chip_limit,
+        r.cpu_total_milli, r.cpu_request_milli,
+        r.mem_total_mega, r.mem_request_mega,
+        max_load_desired, pid, out,
+    )
+    if rc != 0:
+        log.warn("native planner returned error", rc=rc)
+        return None
+    return {jobs[i].config.name: int(out[i]) for i in range(n)}

@@ -1,0 +1,57 @@
+// Flat-array C ABI for the native planner (loaded via ctypes —
+// edl_tpu/scheduler/native.py). One call, no allocation handed across
+// the boundary: the caller supplies the output buffer.
+
+#include <cstdint>
+#include <vector>
+
+#include "sched.h"
+
+extern "C" {
+
+// jobs_*: length n_jobs. hosts_*: length n_hosts, pre-sorted by host
+// name (placement order is observable). out_diff: length n_jobs.
+// Returns 0 on success, nonzero on bad args.
+int edl_sched_plan(int64_t n_jobs, const int64_t* job_min,
+                   const int64_t* job_max, const int64_t* job_parallelism,
+                   const int64_t* job_chips, const int64_t* job_cpu_milli,
+                   const int64_t* job_mem_mega, int64_t n_hosts,
+                   const int64_t* host_cpu_idle, const int64_t* host_mem_free,
+                   const int64_t* host_chips_free, int64_t chip_total,
+                   int64_t chip_limit, int64_t cpu_total_milli,
+                   int64_t cpu_request_milli, int64_t mem_total_mega,
+                   int64_t mem_request_mega, double max_load_desired,
+                   int32_t policy, int64_t* out_diff) {
+  if (n_jobs < 0 || n_hosts < 0 || out_diff == nullptr) return 1;
+  if (policy != 0 && policy != 1) return 2;
+
+  std::vector<edlsched::Job> jobs(static_cast<size_t>(n_jobs));
+  for (int64_t i = 0; i < n_jobs; ++i) {
+    jobs[i].min_replicas = job_min[i];
+    jobs[i].max_replicas = job_max[i];
+    jobs[i].parallelism = job_parallelism[i];
+    jobs[i].chips_per_worker = job_chips[i];
+    jobs[i].cpu_request_milli = job_cpu_milli[i];
+    jobs[i].mem_request_mega = job_mem_mega[i];
+  }
+  edlsched::Resource r;
+  r.chip_total = chip_total;
+  r.chip_limit = chip_limit;
+  r.cpu_total_milli = cpu_total_milli;
+  r.cpu_request_milli = cpu_request_milli;
+  r.mem_total_mega = mem_total_mega;
+  r.mem_request_mega = mem_request_mega;
+  r.hosts.resize(static_cast<size_t>(n_hosts));
+  for (int64_t i = 0; i < n_hosts; ++i) {
+    r.hosts[i].cpu_idle_milli = host_cpu_idle[i];
+    r.hosts[i].mem_free_mega = host_mem_free[i];
+    r.hosts[i].chips_free = host_chips_free[i];
+  }
+
+  std::vector<int64_t> diff = edlsched::PlanScale(
+      jobs, r, max_load_desired, static_cast<edlsched::Policy>(policy));
+  for (int64_t i = 0; i < n_jobs; ++i) out_diff[i] = diff[i];
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,188 @@
+// See sched.h. Line references in comments point at the Python twin
+// (edl_tpu/scheduler/autoscaler.py) whose behavior this must match.
+
+#include "sched.h"
+
+#include <algorithm>
+#include <cmath>
+#include <numeric>
+
+namespace edlsched {
+namespace {
+
+bool Legal(Policy p, int64_t n) {
+  switch (p) {
+    case Policy::kFlexible:
+      return n >= 0;
+    case Policy::kPow2:
+      return n >= 1 && (n & (n - 1)) == 0;
+  }
+  return false;
+}
+
+// topology.next_legal
+int64_t NextLegal(int64_t n, int64_t dir, Policy p, int64_t lo, int64_t hi) {
+  int64_t cur = n + dir;
+  if (dir > 0 && cur < lo) cur = lo;
+  if (dir < 0 && cur > hi) cur = hi;
+  while (lo <= cur && cur <= hi) {
+    if (Legal(p, cur)) return cur;
+    cur += dir;
+  }
+  return n;
+}
+
+// topology.floor_legal
+int64_t FloorLegal(int64_t n, Policy p, int64_t lo, int64_t hi) {
+  int64_t cur = std::min(n, hi);
+  while (cur >= lo) {
+    if (Legal(p, cur)) return cur;
+    --cur;
+  }
+  return n;
+}
+
+double Fulfillment(const Job& j) {  // autoscaler.JobState.fulfillment
+  if (j.min_replicas == j.max_replicas) return 1.0;
+  return static_cast<double>(j.parallelism - j.min_replicas) /
+         static_cast<double>(j.max_replicas - j.min_replicas);
+}
+
+// autoscaler.search_assignable_hosts: first-fit over name-sorted hosts,
+// n workers all-or-nothing; fills `placed` with host indices.
+bool SearchAssignable(const Resource& r, const Job& j, int64_t n,
+                      std::vector<Host>& scratch, std::vector<size_t>& placed) {
+  scratch = r.hosts;
+  placed.clear();
+  for (int64_t w = 0; w < n; ++w) {
+    bool found = false;
+    for (size_t i = 0; i < scratch.size(); ++i) {
+      if (j.cpu_request_milli <= scratch[i].cpu_idle_milli &&
+          j.mem_request_mega <= scratch[i].mem_free_mega &&
+          j.chips_per_worker <= scratch[i].chips_free) {
+        scratch[i].cpu_idle_milli -= j.cpu_request_milli;
+        scratch[i].mem_free_mega -= j.mem_request_mega;
+        scratch[i].chips_free -= j.chips_per_worker;
+        placed.push_back(i);
+        found = true;
+        break;
+      }
+    }
+    if (!found) return false;
+  }
+  return true;
+}
+
+// autoscaler.scale_dry_run: one step for one job; accounts the delta in r.
+int64_t ScaleDryRun(Resource& r, const Job& j, int64_t cur_diff,
+                    double max_load, bool scale_down, Policy policy,
+                    std::vector<Host>& scratch, std::vector<size_t>& placed) {
+  const int64_t cpu = j.cpu_request_milli;
+  const int64_t mem = j.mem_request_mega;
+  const int64_t chips = j.chips_per_worker;
+
+  auto account = [&](int64_t n, const std::vector<size_t>* hosts) -> int64_t {
+    r.chip_limit += chips * n;
+    r.cpu_request_milli += cpu * n;
+    r.mem_request_mega += mem * n;
+    if (hosts != nullptr) {
+      for (size_t i : *hosts) {
+        r.hosts[i].cpu_idle_milli -= cpu;
+        r.hosts[i].mem_free_mega -= mem;
+        r.hosts[i].chips_free -= chips;
+      }
+    }
+    return n;
+  };
+
+  const int64_t planned = j.parallelism + cur_diff;
+  const int64_t hi = j.max_replicas;
+  const int64_t lo = j.min_replicas;
+
+  if (scale_down) {
+    if (planned > hi) {
+      if (planned - 1 > hi) return account(-1, nullptr);
+      int64_t target = FloorLegal(planned - 1, policy, lo, hi);
+      return account(target != planned ? target - planned : -1, nullptr);
+    }
+    const bool chip_over =
+        static_cast<double>(r.chip_limit) >
+        static_cast<double>(r.chip_total) * max_load;
+    const bool cpu_over =
+        static_cast<double>(r.cpu_request_milli) >
+        static_cast<double>(r.cpu_total_milli) * max_load;
+    if (chip_over || cpu_over) {
+      if (planned > lo) {
+        int64_t target = NextLegal(planned, -1, policy, lo, hi);
+        return account(target - planned, nullptr);
+      }
+      return 0;
+    }
+    return 0;
+  }
+
+  // scale-up pass
+  if (planned >= hi) {
+    int64_t target = FloorLegal(planned, policy, lo, hi);
+    return account(std::min(target, hi) - planned, nullptr);
+  }
+  int64_t target = NextLegal(planned, +1, policy, lo, hi);
+  int64_t step = target - planned;
+  if (step <= 0) return 0;
+
+  if (r.mem_total_mega - r.mem_request_mega <= mem * step) return 0;
+  if (!SearchAssignable(r, j, step, scratch, placed)) return 0;
+
+  const bool cpu_ok =
+      static_cast<double>(r.cpu_total_milli) * max_load -
+          static_cast<double>(r.cpu_request_milli) >=
+      static_cast<double>(cpu * step);
+  if (chips > 0) {
+    const bool chips_ok = r.chip_total - r.chip_limit >= chips * step;
+    return account((cpu_ok && chips_ok) ? step : 0,
+                   (cpu_ok && chips_ok) ? &placed : nullptr);
+  }
+  return account(cpu_ok ? step : 0, cpu_ok ? &placed : nullptr);
+}
+
+}  // namespace
+
+std::vector<int64_t> PlanScale(const std::vector<Job>& jobs, Resource& r,
+                               double max_load_desired, Policy policy) {
+  std::vector<int64_t> diff(jobs.size(), 0);
+
+  // sorted_jobs: elastic filter; ascending (fulfillment, chips, cpu, mem),
+  // stable like Python's sort.
+  std::vector<size_t> order;
+  for (size_t i = 0; i < jobs.size(); ++i) {
+    if (jobs[i].min_replicas < jobs[i].max_replicas) order.push_back(i);
+  }
+  std::stable_sort(order.begin(), order.end(), [&](size_t a, size_t b) {
+    const Job &ja = jobs[a], &jb = jobs[b];
+    double fa = Fulfillment(ja), fb = Fulfillment(jb);
+    if (fa != fb) return fa < fb;
+    if (ja.chips_per_worker != jb.chips_per_worker)
+      return ja.chips_per_worker < jb.chips_per_worker;
+    if (ja.cpu_request_milli != jb.cpu_request_milli)
+      return ja.cpu_request_milli < jb.cpu_request_milli;
+    return ja.mem_request_mega < jb.mem_request_mega;
+  });
+
+  std::vector<Host> scratch;
+  std::vector<size_t> placed;
+  while (true) {
+    bool no_change = true;
+    auto dry = [&](size_t i, bool down) {
+      int64_t add = ScaleDryRun(r, jobs[i], diff[i], max_load_desired, down,
+                                policy, scratch, placed);
+      diff[i] += add;
+      if (add != 0) no_change = false;
+    };
+    for (size_t i : order) dry(i, false);  // most-starved first
+    for (auto it = order.rbegin(); it != order.rend(); ++it) dry(*it, true);
+    if (no_change) break;
+  }
+  return diff;
+}
+
+}  // namespace edlsched

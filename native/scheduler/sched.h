@@ -1,0 +1,50 @@
+// Native scheduler core — the autoscaler's dry-run fixed point.
+//
+// C++ port of the planning hot loop (reference: scaleAllJobsDryRun /
+// scaleDryRun, pkg/autoscaler.go:201-337; the reference control plane is
+// compiled Go, so the rebuild keeps the scheduler native too). Semantics
+// must stay bit-identical to edl_tpu/scheduler/autoscaler.py —
+// tests/test_native_sched.py cross-checks the two on randomized fleets.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace edlsched {
+
+enum class Policy : int32_t { kFlexible = 0, kPow2 = 1 };
+
+struct Job {
+  int64_t min_replicas = 0;
+  int64_t max_replicas = 0;
+  int64_t parallelism = 0;      // current worker-group target
+  int64_t chips_per_worker = 0;
+  int64_t cpu_request_milli = 0;
+  int64_t mem_request_mega = 0;
+};
+
+struct Host {
+  // hosts must arrive sorted by name: the Python planner walks
+  // `sorted(free_cpu)` and placement order is observable in the plan
+  int64_t cpu_idle_milli = 0;
+  int64_t mem_free_mega = 0;
+  int64_t chips_free = 0;
+};
+
+struct Resource {
+  int64_t chip_total = 0;
+  int64_t chip_limit = 0;
+  int64_t cpu_total_milli = 0;
+  int64_t cpu_request_milli = 0;
+  int64_t mem_total_mega = 0;
+  int64_t mem_request_mega = 0;
+  std::vector<Host> hosts;
+};
+
+// Plans worker-count deltas for every job (same indexing as `jobs`).
+// Mutates `r` the way the dry run accounts proposed placements.
+std::vector<int64_t> PlanScale(const std::vector<Job>& jobs, Resource& r,
+                               double max_load_desired, Policy policy);
+
+}  // namespace edlsched

@@ -1,0 +1,151 @@
+"""Native (C++) planner ≡ Python planner, cross-checked on random fleets.
+
+The native core (native/scheduler/sched.cc) must produce the exact plan
+the Python dry-run fixed point produces — same fulfillment sort, same
+up/down passes, same host first-fit — for both built-in slice policies.
+"""
+
+import numpy as np
+import pytest
+
+from edl_tpu.api.job import TrainingJob
+from edl_tpu.cluster import topology
+from edl_tpu.cluster.fake import FakeCluster, FakeHost
+from edl_tpu.cluster.resource import ClusterResource, Hosts
+from edl_tpu.controller.controller import Controller
+from edl_tpu.scheduler import native as native_sched
+from edl_tpu.scheduler.autoscaler import (
+    Autoscaler,
+    JobState,
+    scale_all_jobs_dry_run,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native_sched.available(), reason="no C++ toolchain"
+)
+
+
+class _Group:
+    def __init__(self, parallelism):
+        self.parallelism = parallelism
+
+
+def _mk_job(name, lo, hi, cur, chips, cpu, mem):
+    job = TrainingJob.from_dict(
+        {
+            "metadata": {"name": name},
+            "spec": {
+                "fault_tolerant": True,
+                "worker": {
+                    "min_replicas": lo,
+                    "max_replicas": hi,
+                    "resources": {
+                        "requests": {"cpu": f"{cpu}m", "memory": f"{mem}M"},
+                        "limits": {"tpu": chips},
+                    },
+                },
+            },
+        }
+    )
+    js = JobState(config=job)
+    js.group = _Group(cur)
+    return js
+
+
+def _mk_resource(rng, n_hosts):
+    hosts = Hosts(
+        cpu_idle_milli={}, mem_free_mega={}, chips_free={}
+    )
+    r = ClusterResource()
+    for i in range(n_hosts):
+        name = f"h{i:02d}"
+        cpu = int(rng.choice([8000, 16000, 32000]))
+        mem = int(rng.choice([16000, 32000]))
+        chips = int(rng.choice([0, 4, 8]))
+        hosts.cpu_idle_milli[name] = cpu
+        hosts.mem_free_mega[name] = mem
+        hosts.chips_free[name] = chips
+        r.cpu_total_milli += cpu
+        r.mem_total_mega += mem
+        r.chip_total += chips
+    r.hosts = hosts
+    return r
+
+
+@pytest.mark.parametrize("policy_name", ["flexible", "pow2"])
+@pytest.mark.parametrize("seed", range(20))
+def test_native_plan_matches_python(seed, policy_name):
+    rng = np.random.RandomState(seed)
+    policy = topology.POLICIES[policy_name]
+    n_jobs = int(rng.randint(1, 6))
+    jobs = []
+    for i in range(n_jobs):
+        lo = int(rng.randint(0, 4))
+        hi = lo + int(rng.randint(0, 8))
+        cur = int(rng.randint(0, hi + 2))
+        chips = int(rng.choice([0, 1, 2, 4]))
+        cpu = int(rng.choice([500, 1000, 4000]))
+        mem = int(rng.choice([100, 1000, 4000]))
+        jobs.append(_mk_job(f"job{i}", lo, hi, cur, chips, cpu, mem))
+
+    r = _mk_resource(rng, int(rng.randint(1, 6)))
+    # book the current usage so totals are consistent-ish
+    for j in jobs:
+        cur = j.group.parallelism
+        r.chip_limit += j.chips_per_worker() * cur
+        r.cpu_request_milli += j.cpu_request_milli() * cur
+        r.mem_request_mega += j.mem_request_mega() * cur
+
+    max_load = float(rng.choice([0.8, 0.9, 0.97, 1.0]))
+
+    py = scale_all_jobs_dry_run(jobs, r.copy(), max_load, policy)
+    nat = native_sched.plan_native(jobs, r, max_load, policy_name)
+    assert nat is not None
+    # python dict contains elastic candidates it touched; native has all
+    for name in nat:
+        assert nat[name] == py.get(name, 0), (
+            f"seed={seed} policy={policy_name} job={name}: "
+            f"native={nat[name]} python={py.get(name, 0)} (full: {nat} vs {py})"
+        )
+
+
+def test_autoscaler_tick_native_matches_python():
+    def build():
+        cluster = FakeCluster(
+            hosts=[FakeHost(f"h{i}", 16000, 32000, 4) for i in range(4)]
+        )
+        return cluster
+
+    def run(use_native):
+        cluster = build()
+        ctl = Controller(
+            cluster,
+            autoscaler=Autoscaler(cluster, max_load_desired=1.0,
+                                  use_native=use_native),
+        )
+        job = TrainingJob.from_dict(
+            {
+                "metadata": {"name": "j"},
+                "spec": {
+                    "fault_tolerant": True,
+                    "worker": {
+                        "min_replicas": 2,
+                        "max_replicas": 8,
+                        "resources": {
+                            "requests": {"cpu": "1000m", "memory": "1Gi"},
+                            "limits": {"tpu": 2},
+                        },
+                    },
+                },
+            }
+        )
+        cluster.submit_job(job)
+        ctl.step()
+        targets = []
+        for _ in range(4):
+            cluster.reconcile()
+            targets.append(dict(ctl.autoscaler.tick()))
+            ctl.step()
+        return targets
+
+    assert run(True) == run(False)
