@@ -34,6 +34,7 @@ from edl_tpu.api.job import MeshSpec
 from edl_tpu.parallel.mesh import MeshPlan
 from edl_tpu.runtime import checkpoint as ckpt
 from edl_tpu.train.trainer import TrainState, global_batch, make_train_step, shard_state
+from edl_tpu.utils import tracing
 from edl_tpu.utils.logging import Timer, kv_logger
 
 log = kv_logger("elastic")
@@ -100,6 +101,8 @@ class ElasticTrainer:
         param_pspecs=None,
         devices: Optional[Sequence[jax.Device]] = None,
         on_reshard: Optional[Callable[[ReshardEvent], None]] = None,
+        checkpoint_dir: Optional[str] = None,
+        checkpoint_every_steps: int = 0,
     ):
         self.loss_fn = loss_fn
         self.tx = tx
@@ -110,6 +113,10 @@ class ElasticTrainer:
         self._pspecs = None  # resolved per-plan in _build
         self.pool = list(devices) if devices is not None else list(jax.devices())
         self.on_reshard = on_reshard
+        # periodic checkpointing (the reference's save_inference_model
+        # cadence, example/ctr/ctr/train.py:169-180, made first-class)
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_every_steps = checkpoint_every_steps
 
         self.n_workers = 0
         self.mesh = None
@@ -140,6 +147,42 @@ class ElasticTrainer:
             devices=self.n_devices,
             mesh=self.plan.describe(),
         )
+
+    def resume(self, params, n_workers: int, checkpoint_path: str) -> None:
+        """Start from a saved checkpoint (crash recovery / warm restart):
+        ``params`` only provides the tree structure; values and the step
+        counter come from disk and are sharded onto the fresh mesh."""
+        self._build(n_workers)
+        template = TrainState.create(params, self.tx)
+        host = ckpt.load(checkpoint_path, template)
+        self.state = ckpt.restore(host, self.plan, self.mesh, self._pspecs)
+        log.info(
+            "elastic trainer resumed",
+            workers=n_workers,
+            step=int(np.asarray(host.step)),
+            checkpoint=checkpoint_path,
+        )
+
+    def maybe_checkpoint(self, force: bool = False) -> Optional[str]:
+        """Write ``checkpoint_dir/step-N`` when the cadence (or ``force``)
+        says so; returns the path written."""
+        if not self.checkpoint_dir or self.state is None:
+            return None
+        step = int(np.asarray(jax.device_get(self.state.step)))
+        if not force and (
+            self.checkpoint_every_steps <= 0
+            or step == 0
+            or step % self.checkpoint_every_steps != 0
+        ):
+            return None
+        import os
+
+        path = os.path.join(self.checkpoint_dir, f"step-{step}")
+        if os.path.exists(os.path.join(path, "state.npz")):
+            return None  # already saved at this step
+        with tracing.span("checkpoint.save", step=step):
+            ckpt.save(path, self.state, {"n_workers": self.n_workers})
+        return path
 
     def _build(self, n_workers: int) -> None:
         n_dev = n_workers * self.chips_per_worker
@@ -200,21 +243,28 @@ class ElasticTrainer:
         prev = self.n_workers
         step_at = int(np.asarray(jax.device_get(self.state.step)))
         log.info("reshard begin", from_workers=prev, to_workers=target)
-        with Timer() as stall:
+        with Timer() as stall, tracing.span(
+            "reshard", from_workers=prev, to_workers=target, step=step_at
+        ):
             old_state = self.state
-            self._build(target)  # new mesh over new device set
+            with tracing.span("reshard.build_mesh", to_workers=target):
+                self._build(target)  # new mesh over new device set
             try:
                 # fast path: direct device-to-device reshard (rides ICI on
                 # real hardware; surviving shards move, no host round trip)
-                self.state = _device_reshard(
-                    old_state, self.plan, self.mesh, self._pspecs
-                )
+                with tracing.span("reshard.device_transfer"):
+                    self.state = _device_reshard(
+                        old_state, self.plan, self.mesh, self._pspecs
+                    )
             except (ValueError, TypeError, RuntimeError) as e:
                 # transfer-layer failures fall back to host-RAM staging;
                 # deterministic spec bugs will fail again here and surface
                 log.warn("device reshard failed; staging via host", error=str(e))
-                host = ckpt.snapshot(old_state)
-                self.state = ckpt.restore(host, self.plan, self.mesh, self._pspecs)
+                with tracing.span("reshard.host_staging"):
+                    host = ckpt.snapshot(old_state)
+                    self.state = ckpt.restore(
+                        host, self.plan, self.mesh, self._pspecs
+                    )
             del old_state
         ev = ReshardEvent(
             from_workers=prev,
@@ -252,10 +302,16 @@ class ElasticTrainer:
             self.state, metrics = self._step_fn(self.state, dev_batch)
             if first_on_mesh:
                 jax.block_until_ready(metrics["loss"])
-                self.report.reshards[-1].recompile_s = time.perf_counter() - tc
+                recompile_s = time.perf_counter() - tc
+                self.report.reshards[-1].recompile_s = recompile_s
+                tracing.tracer().record(
+                    "reshard.recompile", tc, recompile_s,
+                    {"to_workers": self.n_workers},
+                )
             self.report.steps += 1
             self.report.examples += self.global_batch_size
             raw_losses.append(metrics["loss"])
+            self.maybe_checkpoint()
         jax.block_until_ready(self.state.params)
         self.report.train_seconds += time.perf_counter() - t0
         self.report.losses.extend(float(x) for x in raw_losses)

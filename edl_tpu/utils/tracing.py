@@ -1,0 +1,150 @@
+"""Tracing — span recording + JAX profiler hooks.
+
+The reference has no tracing at all (SURVEY §5: closest is log15 caller
+stacks); here the north-star metric is rescale-stall seconds, so the
+elastic runtime emits timed spans (reshard phases, checkpoint I/O,
+recompiles) into a process-wide tracer that can be dumped as
+chrome://tracing / Perfetto JSON. ``jax_profile`` additionally wraps a
+block in the XLA-level profiler (TensorBoard trace) when available.
+
+Usage:
+    from edl_tpu.utils import tracing
+    with tracing.span("reshard", job="ctr", to=8):
+        ...
+    tracing.dump("/tmp/trace.json")
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Optional
+
+from edl_tpu.utils.logging import kv_logger
+
+log = kv_logger("tracing")
+
+
+@dataclass
+class Span:
+    name: str
+    start_s: float  # perf_counter-based, process-relative
+    dur_s: float
+    attrs: Dict[str, Any] = field(default_factory=dict)
+    thread: int = 0
+
+
+class Tracer:
+    """Thread-safe in-memory span recorder."""
+
+    def __init__(self, max_spans: int = 100_000):
+        self._lock = threading.Lock()
+        self._spans: List[Span] = []
+        self._t0 = time.perf_counter()
+        self.max_spans = max_spans
+        self.enabled = True
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs: Any) -> Iterator[None]:
+        if not self.enabled:
+            yield
+            return
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.record(name, start, time.perf_counter() - start, attrs)
+
+    def record(self, name: str, start_s: float, dur_s: float,
+               attrs: Optional[Dict[str, Any]] = None) -> None:
+        """``start_s`` is absolute time.perf_counter(); stored relative to
+        tracer start so chrome-trace timestamps line up across threads."""
+        if not self.enabled:
+            return
+        with self._lock:
+            if len(self._spans) < self.max_spans:
+                self._spans.append(
+                    Span(name, start_s - self._t0, dur_s, dict(attrs or {}),
+                         threading.get_ident())
+                )
+
+    def spans(self, name: Optional[str] = None) -> List[Span]:
+        with self._lock:
+            out = list(self._spans)
+        return [s for s in out if name is None or s.name == name]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        """Per-span-name {count, total_s, max_s} rollup."""
+        out: Dict[str, Dict[str, float]] = {}
+        for s in self.spans():
+            agg = out.setdefault(s.name, {"count": 0, "total_s": 0.0, "max_s": 0.0})
+            agg["count"] += 1
+            agg["total_s"] += s.dur_s
+            agg["max_s"] = max(agg["max_s"], s.dur_s)
+        return out
+
+    def to_chrome_trace(self) -> List[Dict[str, Any]]:
+        """Catapult "X" (complete) events, microsecond units — loadable in
+        chrome://tracing and Perfetto."""
+        return [
+            {
+                "name": s.name,
+                "ph": "X",
+                "ts": s.start_s * 1e6,
+                "dur": s.dur_s * 1e6,
+                "pid": os.getpid(),
+                "tid": s.thread % 2**31,
+                "args": s.attrs,
+            }
+            for s in self.spans()
+        ]
+
+    def dump(self, path: str) -> None:
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump({"traceEvents": self.to_chrome_trace()}, f)
+        log.info("trace written", path=path, spans=len(self.spans()))
+
+
+_global = Tracer()
+
+
+def tracer() -> Tracer:
+    return _global
+
+
+def span(name: str, **attrs: Any):
+    return _global.span(name, **attrs)
+
+
+def dump(path: str) -> None:
+    _global.dump(path)
+
+
+def summary() -> Dict[str, Dict[str, float]]:
+    return _global.summary()
+
+
+@contextlib.contextmanager
+def jax_profile(logdir: str) -> Iterator[None]:
+    """XLA-level profile of the block (TensorBoard trace viewer). No-op
+    when jax.profiler is unavailable (e.g. stripped builds)."""
+    try:
+        import jax
+
+        ctx = jax.profiler.trace(logdir)
+    except Exception as e:  # pragma: no cover
+        log.warn("jax profiler unavailable", error=str(e))
+        ctx = contextlib.nullcontext()
+    with ctx:
+        yield

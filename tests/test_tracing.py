@@ -1,0 +1,115 @@
+"""Tracing subsystem + reshard span instrumentation + checkpoint/resume."""
+
+import json
+import os
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from edl_tpu.models import linreg
+from edl_tpu.runtime import checkpoint as ckpt
+from edl_tpu.runtime.elastic import ElasticTrainer
+from edl_tpu.utils import tracing
+
+
+@pytest.fixture(autouse=True)
+def _clear_tracer():
+    tracing.tracer().clear()
+    yield
+    tracing.tracer().clear()
+
+
+def _data_fn(bs, seed=0):
+    x, y = linreg.synthetic_dataset(max(bs, 64), seed=seed)
+    return lambda n: {"x": x[:n], "y": y[:n]}
+
+
+def _trainer(**kw):
+    return ElasticTrainer(
+        linreg.loss_fn, optax.sgd(0.05), chips_per_worker=1, per_chip_batch=8, **kw
+    )
+
+
+def test_span_recording_and_chrome_dump(tmp_path):
+    tr = tracing.Tracer()
+    with tr.span("outer", job="j"):
+        with tr.span("inner"):
+            pass
+    assert [s.name for s in tr.spans()] == ["inner", "outer"]
+    assert tr.spans("outer")[0].attrs == {"job": "j"}
+    assert tr.summary()["outer"]["count"] == 1
+
+    g_path = str(tmp_path / "t.json")
+    tr.dump(g_path)
+    with open(g_path) as f:
+        events = json.load(f)["traceEvents"]
+    assert len(events) == 2
+    assert all(e["ph"] == "X" and e["dur"] >= 0 for e in events)
+    # inner nests within outer on the timeline
+    inner = next(e for e in events if e["name"] == "inner")
+    outer = next(e for e in events if e["name"] == "outer")
+    assert outer["ts"] <= inner["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1.0
+
+
+def test_reshard_emits_spans(cpu_devices):
+    t = _trainer(devices=cpu_devices[:4])
+    t.start(linreg.init_params(jax.random.PRNGKey(0)), n_workers=2)
+    data = _data_fn(64)
+    t.train_steps(data, 2)
+    t.request_rescale(4)
+    t.train_steps(data, 2)
+    names = {s.name for s in tracing.tracer().spans()}
+    assert "reshard" in names
+    assert "reshard.build_mesh" in names
+    assert "reshard.recompile" in names
+    ev = tracing.tracer().spans("reshard")[0]
+    assert ev.attrs["from_workers"] == 2 and ev.attrs["to_workers"] == 4
+
+
+def test_periodic_checkpoint_and_resume(tmp_path, cpu_devices):
+    cdir = str(tmp_path / "ckpt")
+    t = _trainer(
+        devices=cpu_devices[:4], checkpoint_dir=cdir, checkpoint_every_steps=2
+    )
+    t.start(linreg.init_params(jax.random.PRNGKey(0)), n_workers=2)
+    t.train_steps(_data_fn(64), 5)
+    assert os.path.isdir(os.path.join(cdir, "step-2"))
+    assert os.path.isdir(os.path.join(cdir, "step-4"))
+    assert "checkpoint.save" in tracing.tracer().summary()
+
+    # resume onto a DIFFERENT worker count (elastic warm restart)
+    t2 = _trainer(devices=cpu_devices[:4])
+    t2.resume(
+        linreg.init_params(jax.random.PRNGKey(1)),
+        n_workers=4,
+        checkpoint_path=os.path.join(cdir, "step-4"),
+    )
+    assert int(np.asarray(jax.device_get(t2.state.step))) == 4
+    assert ckpt.load_metadata(os.path.join(cdir, "step-4"))["n_workers"] == 2
+
+    # resumed params equal the checkpointed ones, not the fresh template
+    from edl_tpu.train.trainer import TrainState
+
+    saved = ckpt.load(
+        os.path.join(cdir, "step-4"),
+        TrainState.create(linreg.init_params(jax.random.PRNGKey(1)), optax.sgd(0.05)),
+    )
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(t2.state.params["w"])),
+        np.asarray(saved.params["w"]),
+    )
+    report = t2.train_steps(_data_fn(64), 2)
+    assert int(np.asarray(jax.device_get(t2.state.step))) == 6
+    assert np.isfinite(report.losses).all()
+
+
+def test_force_checkpoint(tmp_path, cpu_devices):
+    t = _trainer(devices=cpu_devices[:2], checkpoint_dir=str(tmp_path))
+    t.start(linreg.init_params(jax.random.PRNGKey(0)), n_workers=2)
+    t.train_steps(_data_fn(32), 1)
+    path = t.maybe_checkpoint(force=True)
+    assert path and os.path.isdir(path)
+    assert t.maybe_checkpoint(force=True) is None  # same step: no rewrite
