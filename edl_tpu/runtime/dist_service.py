@@ -1,0 +1,102 @@
+"""Standalone JAX coordination-service host — one per membership epoch.
+
+In stock JAX the rank-0 process hosts the coordination service
+in-process, so rank-0 death destroys the rendezvous plane and the
+remaining clients' error-pollers abort their processes — leader failure
+is unrecoverable by construction. This helper externalizes the service
+(the same move the reference makes by running etcd in the master pod
+rather than inside a trainer — reference: pkg/jobparser.go:167-184):
+workers are pure clients, and any worker's death — including the
+collective's rank 0 — leaves the service healthy for the survivors'
+orderly disconnect and re-rendezvous.
+
+Spawned per epoch by the rank-0 worker (production: by the controller,
+colocated with the job coordinator). Publishes its address at KV
+``{job}/dist/{epoch}`` once listening. Exits when:
+
+- ``{job}/dist_done/{epoch}/{port}`` is set (scoped to THIS instance's
+  address, so dismissing a dead predecessor cannot kill its respawn);
+- the job coordinator goes away (the job is over); or
+- the membership epoch has moved past ours and stayed there for
+  ``--orphan-grace`` seconds — a group that outlived an epoch bump
+  reshards within seconds, so a long-stale epoch means nobody is (or
+  ever will be) connected. While the epoch is current the service
+  lives indefinitely: workers may be connected and mid-training.
+"""
+
+from __future__ import annotations
+
+import argparse
+import socket
+import sys
+import time
+
+
+def _create_service(bind_host: str, world: int, heartbeat: int, attempts: int = 10):
+    """Bind the service, retrying fresh ports (the probe-then-bind gap
+    is racy; losing it must not be fatal)."""
+    from jax._src.lib import _jax
+
+    last = None
+    for _ in range(attempts):
+        s = socket.socket()
+        s.bind((bind_host, 0))
+        port = s.getsockname()[1]
+        s.close()
+        try:
+            svc = _jax.get_distributed_runtime_service(
+                f"{bind_host}:{port}",
+                world,
+                heartbeat_timeout=heartbeat,
+                shutdown_timeout=10,
+            )
+            return svc, port
+        except Exception as e:  # pragma: no cover - port race
+            last = e
+    raise RuntimeError(f"could not bind coordination service: {last}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--job", required=True)
+    ap.add_argument("--epoch", type=int, required=True)
+    ap.add_argument("--world", type=int, required=True)
+    ap.add_argument("--coordinator", required=True, help="host:port")
+    ap.add_argument("--bind-host", default="127.0.0.1")
+    ap.add_argument("--heartbeat", type=int, default=10)
+    ap.add_argument("--orphan-grace", type=float, default=600.0)
+    a = ap.parse_args(argv)
+
+    from edl_tpu.runtime.coordinator import CoordinatorClient
+
+    host, port = a.coordinator.rsplit(":", 1)
+    cl = CoordinatorClient(host, int(port), 10.0)
+
+    svc, svc_port = _create_service(a.bind_host, a.world, a.heartbeat)
+    cl.kv_put(f"{a.job}/dist/{a.epoch}", f"{a.bind_host}:{svc_port}")
+    done_key = f"{a.job}/dist_done/{a.epoch}/{svc_port}"
+    print(f"dist_service up epoch={a.epoch} port={svc_port}", flush=True)
+    orphan_since = None
+    try:
+        while True:
+            try:
+                if cl.kv_get(done_key):
+                    print("dist_service dismissed", flush=True)
+                    break
+                if cl.epoch() != a.epoch:
+                    orphan_since = orphan_since or time.monotonic()
+                    if time.monotonic() - orphan_since > a.orphan_grace:
+                        print("dist_service orphaned; exiting", flush=True)
+                        break
+                else:
+                    orphan_since = None
+            except Exception:
+                break  # coordinator gone: the job is over
+            time.sleep(0.5)
+    finally:
+        svc.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

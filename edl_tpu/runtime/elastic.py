@@ -22,6 +22,7 @@ the coordinator owns membership epochs (runtime/coordinator.py).
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence
@@ -122,6 +123,7 @@ class ElasticTrainer:
         self.mesh = None
         self.plan: Optional[MeshPlan] = None
         self.state: Optional[TrainState] = None
+        self._host_step = 0  # host mirror of state.step (avoids per-step syncs)
         self._step_fn = None
         self._scale_target: Optional[int] = None
         self.report = TrainReport()
@@ -141,6 +143,7 @@ class ElasticTrainer:
         self._build(n_workers)
         host = TrainState.create(params, self.tx)
         self.state = shard_state(host, self.plan, self.mesh, self._pspecs)
+        self._host_step = 0
         log.info(
             "elastic trainer started",
             workers=n_workers,
@@ -156,6 +159,7 @@ class ElasticTrainer:
         template = TrainState.create(params, self.tx)
         host = ckpt.load(checkpoint_path, template)
         self.state = ckpt.restore(host, self.plan, self.mesh, self._pspecs)
+        self._host_step = int(np.asarray(host.step))
         log.info(
             "elastic trainer resumed",
             workers=n_workers,
@@ -168,15 +172,13 @@ class ElasticTrainer:
         says so; returns the path written."""
         if not self.checkpoint_dir or self.state is None:
             return None
-        step = int(np.asarray(jax.device_get(self.state.step)))
+        step = self._host_step  # host mirror: no device sync on the hot path
         if not force and (
             self.checkpoint_every_steps <= 0
             or step == 0
             or step % self.checkpoint_every_steps != 0
         ):
             return None
-        import os
-
         path = os.path.join(self.checkpoint_dir, f"step-{step}")
         if os.path.exists(os.path.join(path, "state.npz")):
             return None  # already saved at this step
@@ -241,7 +243,7 @@ class ElasticTrainer:
                 log.warn("ignoring infeasible rescale target")
             return
         prev = self.n_workers
-        step_at = int(np.asarray(jax.device_get(self.state.step)))
+        step_at = self._host_step
         log.info("reshard begin", from_workers=prev, to_workers=target)
         with Timer() as stall, tracing.span(
             "reshard", from_workers=prev, to_workers=target, step=step_at
@@ -309,6 +311,7 @@ class ElasticTrainer:
                     {"to_workers": self.n_workers},
                 )
             self.report.steps += 1
+            self._host_step += 1
             self.report.examples += self.global_batch_size
             raw_losses.append(metrics["loss"])
             self.maybe_checkpoint()

@@ -1,0 +1,221 @@
+"""ProcessJobLauncher — run an elastic job as real worker processes.
+
+The local-machine realization of the reference's pod lifecycle: the
+controller "creates pods" by spawning worker processes running
+``edl_tpu.runtime.worker_main`` (reference: trainer batch Job pods
+exec'ing docker/paddle_k8s), scales up by spawning more, and scales
+down by SIGTERM-ing the highest-numbered workers (reference: the k8s
+Job controller shrinking ``Parallelism``,  pkg/autoscaler.go:361).
+A per-job coordinator process (runtime/coordinator.py, the etcd/master
+analog) provides membership, rendezvous KV, and the data task queue.
+
+This is also the multi-host template: on a TPU pod slice each "worker"
+is one host process and ``EDL_LOCAL_DEVICES`` is unset so the real
+backend is used.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from edl_tpu.runtime.coordinator import CoordinatorClient, CoordinatorServer
+from edl_tpu.utils.logging import kv_logger
+
+log = kv_logger("launcher")
+
+
+@dataclass
+class WorkerProc:
+    worker_id: str
+    proc: subprocess.Popen
+    log_path: str
+
+
+@dataclass
+class ProcessJobLauncher:
+    job: str = "job"
+    model: str = "linreg"
+    min_workers: int = 1
+    max_workers: int = 8
+    n_samples: int = 2048
+    passes: int = 1
+    per_device_batch: int = 32
+    local_devices: int = 1  # 0 = use the real backend
+    work_dir: str = "."
+    member_ttl_s: float = 3.0
+    lease_timeout_s: float = 4.0
+    fault_tolerant: bool = True
+    seed: int = 0
+    step_sleep_s: float = 0.0
+    extra_env: Dict[str, str] = field(default_factory=dict)
+
+    def __post_init__(self):
+        os.makedirs(self.ckpt_dir, exist_ok=True)
+        os.makedirs(self.log_dir, exist_ok=True)
+        self.server = CoordinatorServer(member_ttl_s=self.member_ttl_s)
+        self.client: CoordinatorClient = self.server.client()
+        self.workers: List[WorkerProc] = []
+        self._next_id = 0
+
+    @property
+    def ckpt_dir(self) -> str:
+        return os.path.join(self.work_dir, "ckpt")
+
+    @property
+    def log_dir(self) -> str:
+        return os.path.join(self.work_dir, "logs")
+
+    # -- pod lifecycle -------------------------------------------------------
+
+    def _env(self, worker_id: str) -> Dict[str, str]:
+        env = dict(os.environ)
+        env.update(
+            {
+                "EDL_JOB_NAME": self.job,
+                "EDL_WORKER_ID": worker_id,
+                "EDL_COORDINATOR": f"127.0.0.1:{self.server.port}",
+                "EDL_WORKERS": str(self.min_workers),
+                "EDL_WORKERS_MIN": str(self.min_workers),
+                "EDL_WORKERS_MAX": str(self.max_workers),
+                "EDL_FAULT_TOLERANT": "1" if self.fault_tolerant else "0",
+                "EDL_MODEL": self.model,
+                "EDL_LOCAL_DEVICES": str(self.local_devices),
+                "EDL_PER_DEVICE_BATCH": str(self.per_device_batch),
+                "EDL_NUM_SAMPLES": str(self.n_samples),
+                "EDL_NUM_PASSES": str(self.passes),
+                "EDL_LEASE_TIMEOUT_S": str(self.lease_timeout_s),
+                "EDL_MEMBER_TTL_S": str(self.member_ttl_s),
+                "EDL_CKPT_DIR": self.ckpt_dir,
+                "EDL_LOG_DIR": self.log_dir,
+                "EDL_SEED": str(self.seed),
+                "EDL_STEP_SLEEP_S": str(self.step_sleep_s),
+                "PYTHONPATH": os.pathsep.join(
+                    [
+                        os.path.dirname(
+                            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+                        )
+                    ]
+                    + os.environ.get("PYTHONPATH", "").split(os.pathsep)
+                ).rstrip(os.pathsep),
+            }
+        )
+        if self.local_devices > 0:
+            # override anything inherited from a test parent so the
+            # worker gets exactly the requested virtual chip count
+            env["JAX_PLATFORMS"] = "cpu"
+            env["XLA_FLAGS"] = (
+                f"--xla_force_host_platform_device_count={self.local_devices}"
+            )
+        env.update(self.extra_env)
+        return env
+
+    def spawn(self) -> WorkerProc:
+        worker_id = f"w{self._next_id:03d}"
+        self._next_id += 1
+        log_path = os.path.join(self.log_dir, f"{worker_id}.log")
+        f = open(log_path, "wb")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "edl_tpu.runtime.worker_main"],
+            env=self._env(worker_id),
+            stdout=f,
+            stderr=subprocess.STDOUT,
+        )
+        f.close()  # child holds the fd
+        wp = WorkerProc(worker_id, proc, log_path)
+        self.workers.append(wp)
+        log.info("spawned worker", worker=worker_id, pid=proc.pid)
+        return wp
+
+    def start(self, n_workers: Optional[int] = None) -> None:
+        for _ in range(n_workers if n_workers is not None else self.min_workers):
+            self.spawn()
+
+    def live_workers(self) -> List[WorkerProc]:
+        return [w for w in self.workers if w.proc.poll() is None]
+
+    def scale_to(self, n: int) -> None:
+        """Reference semantics: retargeting Parallelism adds pods or
+        removes the newest ones (graceful SIGTERM drain)."""
+        live = self.live_workers()
+        if n > len(live):
+            for _ in range(n - len(live)):
+                self.spawn()
+        else:
+            for w in sorted(live, key=lambda w: w.worker_id)[n:]:
+                log.info("terminating worker", worker=w.worker_id)
+                w.proc.send_signal(signal.SIGTERM)
+
+    def kill(self, worker_id: str, sig: int = signal.SIGKILL) -> None:
+        """Fault injection: hard-kill a worker (no graceful drain)."""
+        for w in self.live_workers():
+            if w.worker_id == worker_id:
+                w.proc.send_signal(sig)
+                return
+        raise KeyError(worker_id)
+
+    # -- observation ---------------------------------------------------------
+
+    def kv(self, key: str) -> Optional[str]:
+        return self.client.kv_get(f"{self.job}/{key}")
+
+    def progress(self) -> int:
+        return int(self.kv("progress") or "0")
+
+    def wait_progress(self, at_least: int, timeout_s: float = 120.0) -> int:
+        deadline = time.monotonic() + timeout_s
+        while True:
+            p = self.progress()
+            if p >= at_least:
+                return p
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"progress {p} < {at_least}")
+            if all(w.proc.poll() is not None for w in self.workers):
+                raise RuntimeError(f"all workers exited at progress {p}")
+            time.sleep(0.05)
+
+    def wait(self, timeout_s: float = 300.0) -> Dict[str, int]:
+        """Wait for every worker process to exit; {worker_id: returncode}."""
+        deadline = time.monotonic() + timeout_s
+        for w in self.workers:
+            remain = max(0.1, deadline - time.monotonic())
+            try:
+                w.proc.wait(timeout=remain)
+            except subprocess.TimeoutExpired:
+                raise TimeoutError(
+                    f"worker {w.worker_id} still running; "
+                    f"log tail: {self.log_tail(w.worker_id)}"
+                )
+        return {w.worker_id: w.proc.returncode for w in self.workers}
+
+    def log_tail(self, worker_id: str, n_bytes: int = 2000) -> str:
+        for w in self.workers:
+            if w.worker_id == worker_id:
+                with open(w.log_path, "rb") as f:
+                    data = f.read()
+                return data[-n_bytes:].decode(errors="replace")
+        return ""
+
+    def stop(self) -> None:
+        for w in self.live_workers():
+            w.proc.kill()
+        for w in self.workers:
+            if w.proc.poll() is None:
+                try:
+                    w.proc.wait(timeout=5)
+                except subprocess.TimeoutExpired:  # pragma: no cover
+                    pass
+        self.client.close()
+        self.server.stop()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

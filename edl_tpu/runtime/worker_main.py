@@ -1,0 +1,776 @@
+"""Elastic multi-process worker program — real worker processes, zero restarts.
+
+This is the process-level realization of the elastic protocol that
+`runtime/elastic.py` implements in-process: each worker is a separate OS
+process (one per TPU host in production; virtual-CPU JAX processes in
+tests), peers are discovered through the job coordinator
+(runtime/coordinator.py — the etcd/master analog, reference:
+docker/paddle_k8s:14-32), and data comes from the coordinator's task
+queue (reference: cloud_reader + master task queue,
+example/fit_a_line/train_ft.py:105-114).
+
+Lifecycle, per membership epoch ("incarnation" of the collective):
+
+  1. rendezvous: wait until the coordinator's member list is stable,
+     take the deterministic rank (reference: k8s_tools.py fetch_pod_id);
+  2. the rank-0 member spawns the epoch's EXTERNAL coordination-service
+     host (runtime/dist_service.py — outside the workers so leader death
+     is survivable), which publishes the endpoint in coordinator KV;
+     every worker connects as a pure client (world = live members);
+  3. restore train state — from the in-RAM host snapshot if this worker
+     survived the previous epoch, else from the job checkpoint
+     (joiners), else fresh init (job start);
+  4. lockstep training: every step the rank-0 worker publishes ONE
+     decision — ``step`` / ``reshard`` / ``stop`` — in KV and all
+     workers obey it. This is what keeps SPMD collectives aligned
+     across membership change: a worker may only stop stepping after a
+     published ``reshard``/``stop``, so nobody leaves a peer stranded
+     inside an all-reduce. Data tasks are leased per step and acked
+     after the optimizer update (lease timeout redelivers lost work —
+     reference: -task-timout-dur=16s, docker/paddle_k8s:28-31).
+  5. on ``reshard``: snapshot state to host RAM, write the job
+     checkpoint (lowest-rank live worker), ``jax.distributed.shutdown``,
+     clear XLA backends, and loop back to (1) — the process itself
+     never restarts, which is the BASELINE north star ("zero job
+     restarts", <30 s stall).
+
+Scale-up: the controller just starts another worker process; its
+registration bumps the membership epoch, rank 0 notices and publishes
+``reshard``. Scale-down: the controller sends SIGTERM; the worker sets
+a leaving flag but KEEPS stepping until rank 0 publishes ``reshard``
+(graceful drain), then deregisters and exits 0. Crash: lease timeout +
+member TTL expiry bump the epoch; survivors recover from the last
+completed step (the train step does not donate its inputs, so state is
+still live after a failed collective).
+
+Env contract (EDL_*, reference: pkg/jobparser.go:263-311 PADDLE_INIT_*):
+see ``WorkerConfig.from_env``.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from edl_tpu.runtime.coordinator import CoordinatorClient
+from edl_tpu.runtime import entrypoint
+from edl_tpu.utils.logging import kv_logger
+
+log = kv_logger("worker")
+
+_POLL_S = 0.02
+
+
+# --------------------------------------------------------------------------
+# config
+
+
+@dataclass
+class WorkerConfig:
+    job: str
+    worker_id: str
+    coord_host: str
+    coord_port: int
+    min_workers: int
+    max_workers: int
+    fault_tolerant: bool
+    model: str = "linreg"
+    mesh: str = "dp"  # dp | fsdp (batch axis name stays "dp"-like)
+    local_devices: int = 0  # >0: force an n-device virtual CPU platform
+    per_device_batch: int = 32
+    n_samples: int = 4096
+    passes: int = 1
+    lease_timeout_s: float = 16.0
+    member_ttl_s: float = 10.0
+    ckpt_dir: str = ""
+    seed: int = 0
+    vocab: int = 4096  # ctr model hash space (small for tests)
+    rendezvous_timeout_s: float = 120.0
+    step_sleep_s: float = 0.0  # throttle (tests: keeps jobs scalable mid-run)
+
+    @classmethod
+    def from_env(cls, env: Optional[Dict[str, str]] = None) -> "WorkerConfig":
+        e = dict(env if env is not None else os.environ)
+        host, port = (e.get("EDL_COORDINATOR") or "127.0.0.1:7164").rsplit(":", 1)
+        return cls(
+            job=e.get("EDL_JOB_NAME", "job"),
+            worker_id=e.get("EDL_WORKER_ID")
+            or e.get("HOSTNAME")
+            or f"w{os.getpid()}",
+            coord_host=host,
+            coord_port=int(port),
+            min_workers=int(e.get("EDL_WORKERS_MIN", e.get("EDL_WORKERS", "1"))),
+            max_workers=int(e.get("EDL_WORKERS_MAX", e.get("EDL_WORKERS", "1"))),
+            fault_tolerant=e.get("EDL_FAULT_TOLERANT", "0") == "1",
+            model=e.get("EDL_MODEL", "linreg"),
+            mesh=e.get("EDL_MESH", "dp"),
+            local_devices=int(e.get("EDL_LOCAL_DEVICES", "0")),
+            per_device_batch=int(e.get("EDL_PER_DEVICE_BATCH", "32")),
+            n_samples=int(e.get("EDL_NUM_SAMPLES", "4096")),
+            passes=int(e.get("EDL_NUM_PASSES", "1")),
+            lease_timeout_s=float(e.get("EDL_LEASE_TIMEOUT_S", "16")),
+            member_ttl_s=float(e.get("EDL_MEMBER_TTL_S", "10")),
+            ckpt_dir=e.get("EDL_CKPT_DIR", ""),
+            seed=int(e.get("EDL_SEED", "0")),
+            vocab=int(e.get("EDL_VOCAB", "4096")),
+            rendezvous_timeout_s=float(e.get("EDL_RENDEZVOUS_TIMEOUT_S", "120")),
+            step_sleep_s=float(e.get("EDL_STEP_SLEEP_S", "0")),
+        )
+
+
+# --------------------------------------------------------------------------
+# model registry — each entry builds (init_params, loss_fn, batch_fn)
+# where batch_fn(start, end) synthesizes the samples of index range
+# [start, end) deterministically, so any worker can materialize any
+# leased task (the RecordIO-shard analog).
+
+
+def _linreg_workload(cfg: WorkerConfig):
+    import jax
+
+    from edl_tpu.models import linreg
+
+    rng = np.random.RandomState(cfg.seed)
+    w_true = rng.randn(linreg.N_FEATURES, 1).astype(np.float32)
+
+    def batch_fn(start: int, end: int) -> Dict[str, np.ndarray]:
+        r = np.random.RandomState(cfg.seed * 1_000_003 + start + 1)
+        x = r.randn(end - start, linreg.N_FEATURES).astype(np.float32)
+        y = x @ w_true + 0.1 * r.randn(end - start, 1).astype(np.float32)
+        return {"x": x, "y": y}
+
+    return (
+        lambda: linreg.init_params(jax.random.PRNGKey(cfg.seed)),
+        linreg.loss_fn,
+        batch_fn,
+    )
+
+
+def _ctr_workload(cfg: WorkerConfig):
+    import jax
+
+    from edl_tpu.models import ctr
+
+    def batch_fn(start: int, end: int) -> Dict[str, np.ndarray]:
+        r = np.random.RandomState(cfg.seed * 1_000_003 + start + 1)
+        return ctr.synthetic_batch(r, end - start, vocab=cfg.vocab)
+
+    return (
+        lambda: ctr.init_params(jax.random.PRNGKey(cfg.seed), vocab=cfg.vocab),
+        ctr.make_loss_fn(),
+        batch_fn,
+    )
+
+
+WORKLOADS: Dict[str, Callable] = {
+    "linreg": _linreg_workload,
+    "ctr": _ctr_workload,
+}
+
+
+# --------------------------------------------------------------------------
+# platform / jax.distributed plumbing
+
+
+def _setup_platform(cfg: WorkerConfig) -> None:
+    """Platform/env setup only — must NOT query devices: the XLA backend
+    may only initialize after jax.distributed.initialize."""
+    import jax
+
+    if cfg.local_devices > 0:
+        from edl_tpu.utils.platform import prepare_virtual_cpu
+
+        prepare_virtual_cpu(cfg.local_devices)
+        # cross-process CPU collectives need gloo
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+
+def _initialize_distributed(
+    addr: str, world: int, rank: int, timeout_s: int = 60
+) -> None:
+    """Client-only jax.distributed bring-up against an EXTERNAL
+    coordination service (runtime/dist_service.py). Stock
+    ``jax.distributed.initialize`` would make rank 0 host the service
+    in-process, turning rank-0 death into an unrecoverable loss of the
+    rendezvous plane. ``recoverable=True`` keeps a peer's death from
+    being broadcast as a fatal job error to the survivors."""
+    from jax._src import distributed as _dist
+    from jax._src.lib import _jax
+
+    state = _dist.global_state
+    if state.client is not None:  # pragma: no cover - defensive
+        raise RuntimeError("distributed state already initialized")
+    state.client = _jax.get_distributed_runtime_client(
+        addr,
+        rank,
+        init_timeout=timeout_s,
+        heartbeat_timeout=10,
+        shutdown_timeout=10,
+        use_compression=True,
+        recoverable=True,
+    )
+    state.client.connect()
+    state.process_id = rank
+    state.num_processes = world
+    state.coordinator_address = addr
+
+
+def _reset_distributed_state() -> None:
+    """Drop jax.distributed's global state without a disconnect RPC, so
+    a later initialize() starts clean (and jax's atexit shutdown
+    becomes a no-op)."""
+    from jax._src import distributed as _dist
+
+    if _dist.global_state.client is not None:
+        _dist.global_state.client = None
+        _dist.global_state.service = None
+        _dist.global_state.process_id = 0
+        _dist.global_state.num_processes = 0
+
+
+def _shutdown_distributed() -> None:
+    """Tear down jax.distributed, tolerating a dead coordinator (the
+    rank-0 peer may be the one that crashed)."""
+    import jax
+
+    done = threading.Event()
+
+    def _go():
+        try:
+            jax.distributed.shutdown()
+        except Exception as e:  # pragma: no cover - error-path logging
+            log.warn("distributed shutdown error", error=str(e))
+        finally:
+            done.set()
+
+    t = threading.Thread(target=_go, daemon=True)
+    t.start()
+    if not done.wait(timeout=15):  # pragma: no cover
+        log.warn("distributed shutdown timed out; forcing state reset")
+    _reset_distributed_state()
+
+
+def _clear_backends() -> None:
+    import jax
+
+    jax.clear_caches()
+    try:
+        from jax._src import xla_bridge
+
+        xla_bridge._clear_backends()
+    except Exception:  # pragma: no cover - jax-version fallback
+        import jax.extend.backend
+
+        jax.extend.backend.clear_backends()
+
+
+# --------------------------------------------------------------------------
+# the worker
+
+
+class ElasticWorker:
+    def __init__(self, cfg: WorkerConfig):
+        self.cfg = cfg
+        self.client = CoordinatorClient(cfg.coord_host, cfg.coord_port, 30.0)
+        self._leaving = False
+        self._host_state = None  # last completed TrainState, on host
+        self._last_local: Optional[Dict[str, np.ndarray]] = None
+        self._resharded = 0
+
+    # -- keys ----------------------------------------------------------------
+    def _k(self, *parts: str) -> str:
+        return "/".join((self.cfg.job,) + parts)
+
+    # -- SIGTERM: graceful drain --------------------------------------------
+    def _on_sigterm(self, signum, frame):  # pragma: no cover - signal path
+        self._leaving = True
+        try:
+            # separate connection: the main client may be mid-call
+            c = CoordinatorClient(self.cfg.coord_host, self.cfg.coord_port, 5.0)
+            c.kv_put(self._k("leaving", self.cfg.worker_id), "1")
+            c.close()
+        except Exception:
+            pass
+
+    # -- rendezvous ----------------------------------------------------------
+    def _stable_members(self):
+        """Wait until membership is stable (same epoch + members across
+        two reads, no pending leavers among them), then return
+        (epoch, members)."""
+        cl = self.client
+        deadline = time.monotonic() + self.cfg.rendezvous_timeout_s
+        while True:
+            if time.monotonic() > deadline:
+                raise TimeoutError("rendezvous: membership never stabilized")
+            cl.expire()
+            e1 = cl.epoch()
+            ms = cl.members()
+            names = [m.name for m in ms]
+            if self.cfg.worker_id not in names or not names:
+                time.sleep(_POLL_S)
+                continue
+            if any(cl.kv_get(self._k("leaving", n)) for n in names):
+                time.sleep(_POLL_S)  # leaver still deregistering
+                continue
+            time.sleep(0.1)
+            if cl.epoch() == e1 and [m.name for m in cl.members()] == names:
+                return e1, ms
+
+    def _spawn_dist_service(self, epoch: int, world: int) -> None:
+        """Launch the external coordination-service host for this epoch
+        (runtime/dist_service.py). Detached: it must outlive this worker
+        so that rank-0 death cannot take the rendezvous plane with it."""
+        import subprocess
+
+        log_dir = os.environ.get("EDL_LOG_DIR", "")
+        if log_dir:
+            out = open(
+                os.path.join(log_dir, f"dist_service_e{epoch}.log"), "ab"
+            )
+        else:
+            out = subprocess.DEVNULL
+        subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "edl_tpu.runtime.dist_service",
+                "--job", self.cfg.job,
+                "--epoch", str(epoch),
+                "--world", str(world),
+                "--coordinator",
+                f"{self.cfg.coord_host}:{self.cfg.coord_port}",
+            ],
+            stdout=out,
+            stderr=subprocess.STDOUT if log_dir else subprocess.DEVNULL,
+            start_new_session=True,
+        )
+        if log_dir:
+            out.close()  # child holds the fd
+
+    def _rendezvous(self):
+        """Agree on (epoch, rank, world, dist endpoint) with all live
+        peers. The rank-0 member spawns the epoch's external service
+        host, which publishes the endpoint; everyone polls for it.
+        Restarts automatically if membership shifts underfoot."""
+        cl = self.client
+        while True:
+            epoch, members = self._stable_members()
+            me = next(m for m in members if m.name == self.cfg.worker_id)
+            world = len(members)
+            key = self._k("dist", str(epoch))
+            if me.rank == 0 and cl.kv_get(key) is None:
+                self._spawn_dist_service(epoch, world)
+            addr = None
+            deadline = time.monotonic() + self.cfg.rendezvous_timeout_s
+            while addr is None:
+                addr = cl.kv_get(key)
+                if addr is None:
+                    if cl.epoch() != epoch:
+                        break  # membership moved: restart rendezvous
+                    # (an orphan service host self-dismisses after its
+                    # epoch goes stale — dist_service.py --orphan-grace)
+                    if time.monotonic() > deadline:
+                        raise TimeoutError("rendezvous: no dist endpoint")
+                    time.sleep(_POLL_S)
+            if addr is None:
+                continue
+            return epoch, me.rank, world, addr, members
+
+    # -- state placement -----------------------------------------------------
+    def _restore_state(self, init_params, tx, plan, mesh):
+        """Host snapshot (survivor) > job checkpoint (joiner) > fresh
+        init (job start). All processes restore the same step, which the
+        lockstep protocol guarantees is the last completed one."""
+        from edl_tpu.runtime import checkpoint as ckpt
+        from edl_tpu.train.trainer import TrainState, shard_state
+
+        host = self._host_state
+        ck = self.cfg.ckpt_dir
+        if host is None and ck and os.path.exists(os.path.join(ck, "state.npz")):
+            like = TrainState.create(init_params(), tx)
+            host = ckpt.load(ck, like)
+            log.info("restored from checkpoint", step=int(host.step))
+        if host is None:
+            host = TrainState.create(init_params(), tx)
+        return shard_state(host, plan, mesh)
+
+    def _write_checkpoint(self, host_state) -> None:
+        from edl_tpu.runtime import checkpoint as ckpt
+
+        if self.cfg.ckpt_dir:
+            ckpt.save(
+                self.cfg.ckpt_dir,
+                host_state,
+                {"job": self.cfg.job, "step": int(host_state.step)},
+            )
+            self.client.kv_put(self._k("ckpt_step"), str(int(host_state.step)))
+
+    def _checkpoint_writer_rank(self, members) -> int:
+        """Lowest-rank epoch member that is still alive and not
+        draining — every lockstep worker holds the same state, so any
+        one can write; picking one keeps production I/O sane. Liveness
+        matters: if the would-be writer died (e.g. rank 0 crashed), a
+        survivor must write, or a joiner would restore a stale step."""
+        alive = {m.name for m in self.client.members()}
+        candidates = [
+            m.rank
+            for m in members
+            if m.name in alive
+            and not self.client.kv_get(self._k("leaving", m.name))
+        ]
+        return min(candidates) if candidates else 0
+
+    # -- the run -------------------------------------------------------------
+    def run(self) -> int:
+        cfg = self.cfg
+        _setup_platform(cfg)
+        import jax
+
+        import optax
+
+        from edl_tpu.parallel.mesh import MeshPlan
+
+        init_params, loss_fn, batch_fn = WORKLOADS[cfg.model](cfg)
+        tx = optax.adam(1e-2 if cfg.model == "linreg" else 1e-3)
+
+        if self._leaving:  # SIGTERM during startup: never joined
+            return 0
+        ctx = entrypoint.bootstrap(self.client)
+        heartbeat_stop = self._start_heartbeat(ctx.incarnation)
+        try:
+            return self._epochs(cfg, jax, MeshPlan, init_params, loss_fn, batch_fn, tx)
+        except Exception as e:
+            entrypoint.record_failure(self.client, cfg.job, f"exception: {e}")
+            raise
+        finally:
+            heartbeat_stop.set()
+
+    def _start_heartbeat(self, incarnation: int) -> threading.Event:
+        """TTL keep-alive on its own connection (steps may outlast the
+        member TTL). Survives transient coordinator hiccups by
+        reconnecting, and re-registers if a missed TTL already evicted
+        us — the re-registration bumps the epoch, which correctly shows
+        up to the group as a membership change."""
+        stop = threading.Event()
+        cfg = self.cfg
+        interval = min(0.5, max(0.1, cfg.member_ttl_s / 4))
+
+        def _beat():  # pragma: no cover - timing-dependent
+            c = None
+            while not stop.wait(interval):
+                try:
+                    if c is None:
+                        c = CoordinatorClient(cfg.coord_host, cfg.coord_port, 5.0)
+                    if not c.heartbeat(cfg.worker_id) and not self._leaving:
+                        log.warn("TTL-evicted while alive; re-registering")
+                        c.register(cfg.worker_id, incarnation)
+                except Exception:
+                    try:
+                        if c is not None:
+                            c.close()
+                    except Exception:
+                        pass
+                    c = None
+            if c is not None:
+                try:
+                    c.close()
+                except Exception:
+                    pass
+
+        threading.Thread(target=_beat, daemon=True).start()
+        return stop
+
+    def _epochs(self, cfg, jax, MeshPlan, init_params, loss_fn, batch_fn, tx) -> int:
+        from edl_tpu.train.trainer import make_train_step
+
+        cl = self.client
+        init_failures = 0
+        while True:
+            if self._leaving:
+                return self._depart(code=0)
+            epoch, rank, world, addr, members = self._rendezvous()
+            log.info(
+                "epoch up", epoch=epoch, rank=rank, world=world, dist=addr
+            )
+            try:
+                _initialize_distributed(addr, world, rank)
+                init_failures = 0
+            except Exception as e:
+                # a peer died between rendezvous and connect (its TTL
+                # expiry will bump the epoch) — or the service host
+                # itself died with membership unchanged. Retract the
+                # endpoint we failed against (guarded: only if still
+                # current) so the next rendezvous respawns a fresh host
+                # instead of spinning on the corpse.
+                log.warn("distributed init failed; regrouping", error=str(e))
+                _shutdown_distributed()
+                if cl.kv_get(self._k("dist", str(epoch))) == addr:
+                    cl.kv_del(self._k("dist", str(epoch)))
+                    cl.kv_put(self._dist_done_key(epoch, addr), "1")
+                init_failures += 1
+                if init_failures >= 5:
+                    raise RuntimeError(
+                        f"distributed init failed {init_failures}x; giving up"
+                    ) from e
+                continue
+            # jax.distributed installs a C++ SIGTERM preemption notifier
+            # that would swallow our graceful-drain handler — take it back
+            signal.signal(signal.SIGTERM, self._on_sigterm)
+            devs = jax.devices()
+            plan = (
+                MeshPlan.fsdp_only(len(devs))
+                if cfg.mesh == "fsdp"
+                else MeshPlan.data_parallel(len(devs))
+            )
+            mesh = plan.build(devs)
+            state = self._restore_state(init_params, tx, plan, mesh)
+            # donate=False: after a failed collective (peer crash) the
+            # pre-step buffers must still be alive to recover from.
+            step = make_train_step(loss_fn, tx, plan, mesh, donate=False)
+
+            if rank == 0:
+                self._ensure_queue(cl)
+            outcome = self._train_epoch(
+                cfg, jax, cl, epoch, rank, world, plan, mesh, state, step,
+                batch_fn, members,
+            )
+            self._teardown_epoch(cl, epoch, rank, members, addr)
+            if outcome == "stop":
+                return self._finish(rank)
+            # outcome == "reshard": state already snapshotted
+            self._resharded += 1
+            # monotonic max-write: a late joiner's small private count
+            # must not clobber the job-wide one
+            if self._resharded > int(cl.kv_get(self._k("reshards")) or "0"):
+                cl.kv_put(self._k("reshards"), str(self._resharded))
+            _clear_backends()
+            if self._leaving:
+                return self._depart(code=0)
+
+    def _ensure_queue(self, cl) -> None:
+        cfg = self.cfg
+        if not cl.kv_get(self._k("queue_inited")):
+            chunk = cfg.per_device_batch * max(cfg.local_devices, 1)
+            cl.queue_init(
+                cfg.n_samples,
+                chunk,
+                passes=cfg.passes,
+                lease_timeout_s=cfg.lease_timeout_s,
+            )
+            cl.kv_put(self._k("queue_inited"), "1")
+
+    def _local_batch(self, cl, batch_fn):
+        """Lease one task; fall back to replaying the previous local
+        batch when the queue has no task for us this step (tail rounds —
+        coverage still exactly-once via acks; replay only pads the SPMD
+        shape). Returns (local_np_batch, task_id_or_None)."""
+        task = cl.lease(self.cfg.worker_id)
+        if task is not None:
+            local = batch_fn(task.start, task.end)
+            self._last_local = local
+            return local, task.task_id
+        if self._last_local is not None:
+            return self._last_local, None
+        # first-ever step with no task: zero batch of chunk shape
+        chunk = self.cfg.per_device_batch * max(self.cfg.local_devices, 1)
+        probe = batch_fn(0, chunk)
+        return {
+            k: np.zeros_like(v) for k, v in probe.items()
+        }, None
+
+    def _train_epoch(
+        self, cfg, jax, cl, epoch, rank, world, plan, mesh, state, step,
+        batch_fn, members,
+    ):
+        """Lockstep loop. Returns "stop" | "reshard" with
+        self._host_state holding the last completed step."""
+        from edl_tpu.runtime import checkpoint as ckpt
+
+        go_key = self._k("go", str(epoch))
+        sharding = plan.batch_sharding(mesh)
+        first_loss_key = self._k("loss_first")
+        while True:
+            i = int(jax.device_get(state.step))
+            if rank == 0:
+                verb = self._decide(cl, epoch)
+                cl.kv_put(go_key, f"{i}:{verb}")
+            else:
+                verb = self._await_go(cl, go_key, i, members)
+            if verb == "step":
+                local, task_id = self._local_batch(cl, batch_fn)
+                gbatch = jax.tree_util.tree_map(
+                    lambda x: jax.make_array_from_process_local_data(
+                        sharding, x
+                    ),
+                    local,
+                )
+                try:
+                    new_state, metrics = step(state, gbatch)
+                    loss = float(jax.device_get(metrics["loss"]))
+                except Exception as e:
+                    # peer died mid-collective: recover from last
+                    # completed state (crash path; epoch will bump once
+                    # the member TTL reaps the dead peer)
+                    log.warn("step failed; recovering", step=i, error=str(e))
+                    if task_id is not None:
+                        cl.nack(task_id)
+                    self._host_state = ckpt.snapshot(state)
+                    self._crash_checkpoint(cl)
+                    self._await_peer_reaped(cl, epoch)
+                    return "reshard"
+                state = new_state
+                if task_id is not None:
+                    cl.ack(task_id)
+                if cfg.step_sleep_s:
+                    time.sleep(cfg.step_sleep_s)
+                if rank == 0:
+                    if not cl.kv_get(first_loss_key):
+                        cl.kv_put(first_loss_key, repr(loss))
+                    cl.kv_put(self._k("loss_last"), repr(loss))
+                    cl.kv_put(self._k("progress"), str(i + 1))
+            else:  # stop | reshard — snapshot the completed state
+                self._host_state = ckpt.snapshot(state)
+                if rank == self._checkpoint_writer_rank(members):
+                    self._write_checkpoint(self._host_state)
+                if verb == "stop":
+                    return "stop"
+                return "reshard"
+
+    def _await_peer_reaped(self, cl, failed_epoch: int) -> None:
+        """A collective just failed, so some peer is dead but may not
+        have TTL-expired yet. Re-rendezvousing before the coordinator
+        reaps it would rebuild the world WITH the corpse — and a
+        jax.distributed connect timeout is fatal. Wait for the epoch to
+        move, then one extra TTL for any other silent deaths."""
+        deadline = time.monotonic() + self.cfg.rendezvous_timeout_s
+        while cl.epoch() == failed_epoch:
+            cl.expire()
+            if time.monotonic() > deadline:  # pragma: no cover
+                raise TimeoutError("dead peer never reaped")
+            time.sleep(0.1)
+        time.sleep(self.cfg.member_ttl_s)
+        cl.expire()
+
+    def _crash_checkpoint(self, cl) -> None:
+        """After a failed collective any survivor may be the only one
+        left; newest state wins (atomic rename, identical content among
+        lockstep peers)."""
+        have = int(self._host_state.step)
+        known = int(cl.kv_get(self._k("ckpt_step")) or "-1")
+        if have > known:
+            self._write_checkpoint(self._host_state)
+
+    def _decide(self, cl, epoch: int) -> str:
+        cl.expire()
+        if self._leaving or cl.epoch() != epoch:
+            return "reshard"
+        ms = cl.members()
+        if any(cl.kv_get(self._k("leaving", m.name)) for m in ms):
+            return "reshard"
+        if cl.queue_done():
+            return "stop"
+        return "step"
+
+    def _await_go(self, cl, go_key: str, i: int, members) -> str:
+        """Wait for rank 0's decision for step ``i``. A published
+        decision always wins (rank 0 may already be inside the step's
+        collective). Only when there is NO decision yet AND rank 0 has
+        left membership (crashed + TTL-reaped, or departed) can it
+        never publish again — treat that as a reshard. Note: a mere
+        epoch bump is NOT a bail-out signal; rank 0 may be alive and
+        about to publish ``step``, and abandoning it then would strand
+        it inside the collective."""
+        deadline = time.monotonic() + self.cfg.rendezvous_timeout_s
+        prefix = f"{i}:"
+        rank0 = next(m.name for m in members if m.rank == 0)
+        while True:
+            v = cl.kv_get(go_key)
+            if v and v.startswith(prefix):
+                return v.split(":", 1)[1]
+            cl.expire()
+            if rank0 not in {m.name for m in cl.members()}:
+                log.warn("rank-0 worker gone; resharding", step=i)
+                return "reshard"
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"no go decision for step {i}")
+            time.sleep(_POLL_S)
+
+    def _dist_done_key(self, epoch: int, addr: str) -> str:
+        """Dismissal key scoped to one service instance's address, so
+        dismissing a dead host cannot kill its respawn at the same
+        epoch."""
+        return self._k("dist_done", str(epoch), addr.rsplit(":", 1)[1])
+
+    def _teardown_epoch(self, cl, epoch: int, rank: int, members, addr: str) -> None:
+        """Ordered disconnect from this epoch's (external) coordination
+        service. A live leader — the lowest-rank surviving member, since
+        rank 0 itself may be the casualty — waits for every other live
+        member's disconnect mark, disconnects last, and dismisses the
+        service host via ``dist_done``. Dismissing it earlier would
+        abort still-connected peers (their error pollers treat a dead
+        service as fatal)."""
+        me = self.cfg.worker_id
+        disc = lambda name: self._k("disc", str(epoch), name)  # noqa: E731
+        cl.expire()
+        alive = {m.name for m in cl.members()}
+        leader = min(
+            (m.rank for m in members if m.name in alive), default=rank
+        )
+        if rank == leader:
+            peers = [m.name for m in members if m.name != me]
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                cl.expire()
+                live = {m.name for m in cl.members()}
+                if all(cl.kv_get(disc(p)) or p not in live for p in peers):
+                    break
+                time.sleep(_POLL_S)
+            _shutdown_distributed()
+            cl.kv_put(self._dist_done_key(epoch, addr), "1")
+            return
+        _shutdown_distributed()
+        cl.kv_put(disc(me), "1")
+
+    def _finish(self, rank: int) -> int:
+        cl = self.client
+        if rank == 0:
+            cl.kv_put(self._k("phase"), "succeeded")
+        log.info("job complete", worker=self.cfg.worker_id)
+        cl.leave(self.cfg.worker_id)
+        cl.release_worker(self.cfg.worker_id)
+        return 0
+
+    def _depart(self, code: int) -> int:
+        cl = self.client
+        log.info("departing (scale-down)", worker=self.cfg.worker_id)
+        cl.release_worker(self.cfg.worker_id)
+        cl.leave(self.cfg.worker_id)
+        cl.kv_del(self._k("leaving", self.cfg.worker_id))
+        return code
+
+
+def main(argv=None) -> int:
+    from edl_tpu.utils.logging import configure
+
+    configure(os.environ.get("EDL_LOG_LEVEL", "info"))
+    cfg = WorkerConfig.from_env()
+    worker = ElasticWorker(cfg)
+    # install BEFORE the heavy jax import: a scale-down SIGTERM can land
+    # while the worker is still starting up
+    signal.signal(signal.SIGTERM, worker._on_sigterm)
+    try:
+        return worker.run()
+    except entrypoint.FailureGateError as e:
+        log.error("failure gate", error=str(e))
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

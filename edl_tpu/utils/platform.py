@@ -16,13 +16,10 @@ import re
 _FLAG = "--xla_force_host_platform_device_count"
 
 
-def force_virtual_cpu(n_devices: int = 8) -> None:
-    """Force an ``n_devices``-device virtual CPU platform.
-
-    Must run before the first backend query in the process. Raises
-    RuntimeError if a non-CPU backend already won or fewer devices than
-    requested materialized.
-    """
+def prepare_virtual_cpu(n_devices: int = 8) -> None:
+    """Arrange for an ``n_devices``-device virtual CPU platform WITHOUT
+    touching the backend (no device query — callers that still need to
+    run ``jax.distributed.initialize`` must not initialize XLA yet)."""
     flags = os.environ.get("XLA_FLAGS", "")
     m = re.search(re.escape(_FLAG) + r"=(\d+)", flags)
     if m is None:
@@ -36,6 +33,19 @@ def force_virtual_cpu(n_devices: int = 8) -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+
+def force_virtual_cpu(n_devices: int = 8) -> None:
+    """Force an ``n_devices``-device virtual CPU platform.
+
+    Must run before the first backend query in the process. Raises
+    RuntimeError if a non-CPU backend already won or fewer devices than
+    requested materialized.
+    """
+    prepare_virtual_cpu(n_devices)
+
+    import jax
+
     devs = jax.devices()
     if devs[0].platform != "cpu" or len(devs) < n_devices:
         raise RuntimeError(

@@ -47,6 +47,7 @@ class Tracer:
         self._t0 = time.perf_counter()
         self.max_spans = max_spans
         self.enabled = True
+        self.dropped = 0  # spans discarded after the buffer filled
 
     @contextlib.contextmanager
     def span(self, name: str, **attrs: Any) -> Iterator[None]:
@@ -71,6 +72,13 @@ class Tracer:
                     Span(name, start_s - self._t0, dur_s, dict(attrs or {}),
                          threading.get_ident())
                 )
+            else:
+                if self.dropped == 0:
+                    log.warn(
+                        "span buffer full; dropping further spans",
+                        max_spans=self.max_spans,
+                    )
+                self.dropped += 1
 
     def spans(self, name: Optional[str] = None) -> List[Span]:
         with self._lock:
@@ -80,6 +88,7 @@ class Tracer:
     def clear(self) -> None:
         with self._lock:
             self._spans.clear()
+            self.dropped = 0
 
     def summary(self) -> Dict[str, Dict[str, float]]:
         """Per-span-name {count, total_s, max_s} rollup."""
@@ -112,8 +121,16 @@ class Tracer:
         if d:
             os.makedirs(d, exist_ok=True)
         with open(path, "w") as f:
-            json.dump({"traceEvents": self.to_chrome_trace()}, f)
-        log.info("trace written", path=path, spans=len(self.spans()))
+            json.dump(
+                {"traceEvents": self.to_chrome_trace(), "dropped": self.dropped},
+                f,
+            )
+        log.info(
+            "trace written",
+            path=path,
+            spans=len(self.spans()),
+            dropped=self.dropped,
+        )
 
 
 _global = Tracer()
@@ -143,8 +160,12 @@ def jax_profile(logdir: str) -> Iterator[None]:
         import jax
 
         ctx = jax.profiler.trace(logdir)
+        ctx.__enter__()  # may raise too (nested trace, unwritable logdir)
     except Exception as e:  # pragma: no cover
         log.warn("jax profiler unavailable", error=str(e))
-        ctx = contextlib.nullcontext()
-    with ctx:
+        ctx = None
+    try:
         yield
+    finally:
+        if ctx is not None:
+            ctx.__exit__(None, None, None)

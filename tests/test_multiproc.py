@@ -1,0 +1,159 @@
+"""Multi-process elastic training e2e — real worker processes.
+
+The process-level analog of the reference's manual elastic demo
+(reference: doc/boss_tutorial.md — jobs scaled while running, trainers
+killed, job finishes anyway): workers are separate OS processes on a
+virtual-CPU JAX backend with gloo cross-process collectives, membership
+and data dispatch ride the native coordinator, and membership change is
+an in-place ``jax.distributed`` re-init — the processes themselves
+never restart (BASELINE north star: zero job restarts).
+
+These tests do NOT use the in-process cpu_devices fixture — each worker
+subprocess owns its own JAX runtime.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from edl_tpu.runtime.launcher import ProcessJobLauncher
+
+
+def _assert_succeeded(launcher, rcs):
+    assert all(rc == 0 for rc in rcs.values()), (
+        rcs,
+        {w: launcher.log_tail(w) for w in rcs},
+    )
+    assert launcher.kv("phase") == "succeeded"
+
+
+def test_two_workers_train_and_complete(tmp_path):
+    with ProcessJobLauncher(
+        job="mp2",
+        model="linreg",
+        min_workers=2,
+        max_workers=4,
+        n_samples=1024,
+        passes=1,
+        per_device_batch=32,
+        work_dir=str(tmp_path),
+    ) as launcher:
+        launcher.start(2)
+        rcs = launcher.wait(timeout_s=180)
+        _assert_succeeded(launcher, rcs)
+        first = float(launcher.kv("loss_first"))
+        last = float(launcher.kv("loss_last"))
+        assert last < first, (first, last)
+        # final checkpoint exists and carries the final step
+        assert os.path.exists(os.path.join(launcher.ckpt_dir, "state.npz"))
+        assert int(launcher.kv("ckpt_step")) == launcher.progress()
+
+
+def test_scale_up_reshards_in_place(tmp_path):
+    with ProcessJobLauncher(
+        job="mpup",
+        model="linreg",
+        min_workers=1,
+        max_workers=4,
+        n_samples=8192,
+        passes=1,
+        per_device_batch=32,
+        step_sleep_s=0.05,
+        work_dir=str(tmp_path),
+    ) as launcher:
+        launcher.start(1)
+        launcher.wait_progress(3, timeout_s=120)
+        launcher.scale_to(2)
+        rcs = launcher.wait(timeout_s=240)
+        _assert_succeeded(launcher, rcs)
+        assert len(rcs) == 2
+        assert int(launcher.kv("reshards") or "0") >= 1
+        # the original worker process survived the reshard in place (no
+        # restart): ONE process's log shows more than one epoch bring-up
+        log0 = launcher.log_tail("w000", n_bytes=100_000)
+        assert log0.count("epoch up") >= 2, log0
+        assert float(launcher.kv("loss_last")) < float(launcher.kv("loss_first"))
+
+
+def test_scale_down_graceful_drain(tmp_path):
+    with ProcessJobLauncher(
+        job="mpdown",
+        model="linreg",
+        min_workers=3,
+        max_workers=4,
+        n_samples=8192,
+        passes=1,
+        per_device_batch=32,
+        step_sleep_s=0.05,
+        work_dir=str(tmp_path),
+    ) as launcher:
+        launcher.start(3)
+        launcher.wait_progress(3, timeout_s=120)
+        launcher.scale_to(2)  # SIGTERM the newest worker: graceful drain
+        rcs = launcher.wait(timeout_s=240)
+        _assert_succeeded(launcher, rcs)  # including the drained worker
+        assert int(launcher.kv("reshards") or "0") >= 1
+
+
+def test_crash_sigkill_survivors_recover(tmp_path):
+    """Hard-kill (no drain, no termination log): survivors recover from
+    the last completed step via member-TTL expiry + collective failure
+    (reference analog: pod deleted mid-job, master requeues its tasks)."""
+    with ProcessJobLauncher(
+        job="mpkill",
+        model="linreg",
+        min_workers=2,
+        max_workers=4,
+        n_samples=8192,
+        passes=1,
+        per_device_batch=32,
+        step_sleep_s=0.05,
+        member_ttl_s=2.0,
+        lease_timeout_s=3.0,
+        work_dir=str(tmp_path),
+    ) as launcher:
+        launcher.start(2)
+        launcher.wait_progress(3, timeout_s=120)
+        victim = launcher.live_workers()[-1].worker_id
+        launcher.kill(victim)
+        rcs = launcher.wait(timeout_s=300)
+        assert rcs.pop(victim) != 0
+        assert all(rc == 0 for rc in rcs.values()), (
+            rcs,
+            {w: launcher.log_tail(w) for w in rcs},
+        )
+        assert launcher.kv("phase") == "succeeded"
+        assert float(launcher.kv("loss_last")) < float(launcher.kv("loss_first"))
+
+
+def test_crash_sigkill_rank0_survivors_recover(tmp_path):
+    """Worst case: the dead worker is rank 0 — it hosted the JAX
+    coordination service AND published the per-step go decisions.
+    Survivors must notice it left membership (TTL reap), reshard without
+    a disconnect RPC, and finish the job."""
+    with ProcessJobLauncher(
+        job="mpkill0",
+        model="linreg",
+        min_workers=2,
+        max_workers=4,
+        n_samples=8192,
+        passes=1,
+        per_device_batch=32,
+        step_sleep_s=0.05,
+        member_ttl_s=2.0,
+        lease_timeout_s=3.0,
+        work_dir=str(tmp_path),
+    ) as launcher:
+        launcher.start(2)
+        launcher.wait_progress(3, timeout_s=120)
+        victim = launcher.live_workers()[0].worker_id  # first = rank 0
+        launcher.kill(victim)
+        rcs = launcher.wait(timeout_s=300)
+        assert rcs.pop(victim) != 0
+        assert all(rc == 0 for rc in rcs.values()), (
+            rcs,
+            {w: launcher.log_tail(w) for w in rcs},
+        )
+        assert launcher.kv("phase") == "succeeded"
+        assert float(launcher.kv("loss_last")) < float(launcher.kv("loss_first"))
