@@ -566,20 +566,36 @@ class ElasticWorker:
             )
             cl.kv_put(self._k("queue_inited"), "1")
 
+    def _chunk(self) -> int:
+        return self.cfg.per_device_batch * max(self.cfg.local_devices, 1)
+
+    @staticmethod
+    def _pad_to(batch: Dict[str, np.ndarray], n: int) -> Dict[str, np.ndarray]:
+        """Wrap-pad every leaf's leading dim to exactly ``n`` samples.
+        SPMD peers must contribute identical local shapes every step, so
+        a ragged tail task (n_samples % chunk) is padded by repeating
+        its own samples — coverage accounting stays exact via acks; the
+        repeats only even out the tensor shape."""
+        have = next(iter(batch.values())).shape[0]
+        if have == n:
+            return batch
+        idx = np.resize(np.arange(have), n)
+        return {k: v[idx] for k, v in batch.items()}
+
     def _local_batch(self, cl, batch_fn):
         """Lease one task; fall back to replaying the previous local
         batch when the queue has no task for us this step (tail rounds —
         coverage still exactly-once via acks; replay only pads the SPMD
         shape). Returns (local_np_batch, task_id_or_None)."""
+        chunk = self._chunk()
         task = cl.lease(self.cfg.worker_id)
         if task is not None:
-            local = batch_fn(task.start, task.end)
+            local = self._pad_to(batch_fn(task.start, task.end), chunk)
             self._last_local = local
             return local, task.task_id
         if self._last_local is not None:
             return self._last_local, None
         # first-ever step with no task: zero batch of chunk shape
-        chunk = self.cfg.per_device_batch * max(self.cfg.local_devices, 1)
         probe = batch_fn(0, chunk)
         return {
             k: np.zeros_like(v) for k, v in probe.items()
