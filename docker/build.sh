@@ -1,0 +1,19 @@
+#!/bin/bash
+# Build the controller and worker images. Port of the reference's
+# image build script (reference: docker/build.sh:1-44, which produced
+# CPU and GPU runtime variants); here the variants are a CPU-only
+# controller image and a TPU worker image.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+TAG=${TAG:-latest}
+REGISTRY=${REGISTRY:-edl-tpu}
+# TPU worker base: any image with Python >= 3.10; jax[tpu] is pulled in
+# at build time. Override for an air-gapped registry mirror.
+WORKER_BASE=${WORKER_BASE:-python:3.11-slim}
+
+docker build -f docker/Dockerfile.controller -t "${REGISTRY}/controller:${TAG}" .
+docker build -f docker/Dockerfile.worker --build-arg "BASE=${WORKER_BASE}" \
+    -t "${REGISTRY}/worker:${TAG}" .
+
+echo "built ${REGISTRY}/controller:${TAG} and ${REGISTRY}/worker:${TAG}"

@@ -773,6 +773,16 @@ class ElasticWorker:
 
 
 def main(argv=None) -> int:
+    import argparse
+
+    # configuration comes from the EDL_* env contract injected by the
+    # controller (api/parser.py pod_env); argv exists for --help only
+    argparse.ArgumentParser(
+        prog="edl-worker",
+        description="elastic worker entrypoint; configured via the EDL_* "
+        "environment contract (EDL_JOB_NAME, EDL_COORDINATOR, EDL_WORKER_ID, "
+        "EDL_WORKERS_MIN/MAX, EDL_FAULT_TOLERANT, EDL_ENTRY, ...)",
+    ).parse_args(argv)
     from edl_tpu.utils.logging import configure
 
     configure(os.environ.get("EDL_LOG_LEVEL", "info"))

@@ -1,0 +1,23 @@
+#!/bin/bash
+# Style / hygiene gate — port of the reference's CI style check
+# (reference: .tools/check_style.sh + .pre-commit-config.yaml: go-fmt,
+# go-vet, go-lint excluding generated code). Uses only the baked-in
+# toolchain: byte-compile every Python file and reject debugger
+# leftovers and tabs in Python source.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== byte-compile =="
+python -m compileall -q edl_tpu tests examples bench.py __graft_entry__.py
+
+echo "== debugger / print leftovers =="
+if grep -rn "breakpoint()\|pdb.set_trace" edl_tpu/ --include='*.py'; then
+    echo "debugger statements found" >&2; exit 1
+fi
+
+echo "== no tabs in python =="
+if grep -rlP '\t' edl_tpu/ tests/ --include='*.py'; then
+    echo "tabs found in python source" >&2; exit 1
+fi
+
+echo "style OK"

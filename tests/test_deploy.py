@@ -1,0 +1,142 @@
+"""Deploy & ops artifacts stay in sync with the code.
+
+The reference ships TPR registration, a controller Deployment, and RBAC
+(reference: k8s/thirdpartyresource.yaml, k8s/edl_controller.yaml,
+k8s/rbac_admin.yaml) plus image builds (reference: Dockerfile,
+docker/build.sh). These tests pin our analogs in deploy/ and docker/
+to the TrainingJob dataclasses and the CLI so schema drift fails CI.
+"""
+
+import dataclasses
+import pathlib
+import subprocess
+import sys
+
+import pytest
+import yaml
+
+from edl_tpu.api.job import (
+    JobPhase,
+    MeshSpec,
+    TrainingJobSpec,
+    TrainingJobStatus,
+    WorkerSpec,
+)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _load_all(rel):
+    return list(yaml.safe_load_all((REPO / rel).read_text()))
+
+
+def _crd_v1_schema():
+    (crd,) = _load_all("deploy/crd.yaml")
+    (v1,) = [v for v in crd["spec"]["versions"] if v["name"] == "v1"]
+    return crd, v1["schema"]["openAPIV3Schema"]
+
+
+def test_crd_spec_covers_dataclass_fields():
+    _, schema = _crd_v1_schema()
+    spec_props = schema["properties"]["spec"]["properties"]
+    declared = set(spec_props)
+    actual = {f.name for f in dataclasses.fields(TrainingJobSpec)}
+    assert declared == actual, (
+        f"CRD spec schema drift: only-in-crd={declared - actual}, "
+        f"missing-from-crd={actual - declared}"
+    )
+    mesh_props = set(spec_props["mesh"]["properties"])
+    assert mesh_props == {f.name for f in dataclasses.fields(MeshSpec)}
+    worker_props = set(spec_props["worker"]["properties"])
+    assert worker_props == {
+        f.name for f in dataclasses.fields(WorkerSpec)
+    }
+
+
+def test_crd_status_phase_enum_matches():
+    _, schema = _crd_v1_schema()
+    status = schema["properties"]["status"]["properties"]
+    assert set(status["phase"]["enum"]) == {p.value for p in JobPhase}
+    declared = set(status)
+    actual = {f.name for f in dataclasses.fields(TrainingJobStatus)}
+    assert actual <= declared
+
+
+def test_crd_group_matches_example_manifests():
+    crd, _ = _crd_v1_schema()
+    group = crd["spec"]["group"]
+    for rel in ("examples/ctr/job.yaml", "examples/llama/job.yaml",
+                "examples/fit_a_line/job.yaml"):
+        (job,) = _load_all(rel)
+        api_group, version = job["apiVersion"].split("/")
+        assert api_group == group, rel
+        assert version in {v["name"] for v in crd["spec"]["versions"]}, rel
+        assert job["kind"] == crd["spec"]["names"]["kind"], rel
+
+
+def test_example_manifests_fit_crd_schema():
+    """Every spec key in every example job must be declared in the CRD
+    schema (k8s would reject unknown fields under structural schemas
+    with pruning)."""
+    _, schema = _crd_v1_schema()
+    spec_props = schema["properties"]["spec"]["properties"]
+    for rel in ("examples/ctr/job.yaml", "examples/llama/job.yaml",
+                "examples/fit_a_line/job.yaml"):
+        (job,) = _load_all(rel)
+        for key, val in job["spec"].items():
+            assert key in spec_props, f"{rel}: spec.{key} not in CRD"
+            sub = spec_props[key]
+            if isinstance(val, dict) and "properties" in sub:
+                for k2 in val:
+                    assert k2 in sub["properties"], f"{rel}: spec.{key}.{k2}"
+
+
+def test_controller_deployment_command_parses():
+    """The Deployment's command line must be accepted by the edl CLI
+    argument parser (flag drift check)."""
+    docs = _load_all("deploy/controller.yaml")
+    (dep,) = [d for d in docs if d and d["kind"] == "Deployment"]
+    container = dep["spec"]["template"]["spec"]["containers"][0]
+    argv = container["command"]
+    assert argv[0] == "edl"
+    from edl_tpu.cli.main import build_parser
+
+    args = build_parser().parse_args(argv[1:])
+    assert args.cmd == "controller"
+    assert args.max_load_desired == pytest.approx(0.9)
+    # the store path must be backed by a volume mount
+    mounts = container.get("volumeMounts", [])
+    assert any(args.store.startswith(m["mountPath"]) for m in mounts)
+    # service account must match the RBAC binding
+    rbac = _load_all("deploy/rbac.yaml")
+    (sa,) = [d for d in rbac if d["kind"] == "ServiceAccount"]
+    assert dep["spec"]["template"]["spec"]["serviceAccountName"] == sa["metadata"]["name"]
+    (binding,) = [d for d in rbac if d["kind"] == "ClusterRoleBinding"]
+    assert binding["subjects"][0]["name"] == sa["metadata"]["name"]
+    assert binding["subjects"][0]["namespace"] == sa["metadata"]["namespace"]
+
+
+def test_rbac_grants_trainingjob_crud():
+    rbac = _load_all("deploy/rbac.yaml")
+    (role,) = [d for d in rbac if d["kind"] == "ClusterRole"]
+    crd, _ = _crd_v1_schema()
+    groups = {g for r in role["rules"] for g in r["apiGroups"]}
+    assert crd["spec"]["group"] in groups
+
+
+def test_style_gate_passes():
+    r = subprocess.run(
+        ["bash", str(REPO / "scripts" / "check_style.sh")],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_worker_image_entrypoint_module_exists():
+    """docker/Dockerfile.worker execs `python -m edl_tpu.runtime.worker_main`;
+    the module must expose a __main__ path."""
+    r = subprocess.run(
+        [sys.executable, "-m", "edl_tpu.runtime.worker_main", "--help"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stderr
