@@ -39,7 +39,9 @@ API_VERSION = "edl-tpu.org/v1"
 KIND = "TrainingJob"
 
 DEFAULT_PORT = 7164  # reference: pkg/jobparser.go:50-51
-DEFAULT_IMAGE = "edl-tpu/job"  # reference default image, jobparser.go:59-60
+# default image for jobs that omit spec.image (reference default image,
+# jobparser.go:59-60); docker/build.sh builds this tag
+DEFAULT_IMAGE = "edl-tpu/worker:latest"
 DEFAULT_PASSES = 1  # reference: pkg/jobparser.go:62-63
 DEFAULT_ACCELERATOR = "v5e"
 

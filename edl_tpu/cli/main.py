@@ -80,6 +80,82 @@ def _job_status_record(cluster, job: TrainingJob) -> dict:
     }
 
 
+def run_controller_kube(args) -> int:
+    """In-cluster daemon: source TrainingJobs from the CRD
+    (deploy/crd.yaml), drive real child resources through the
+    Kubernetes API, publish status to the CRD status subresource —
+    the deployment mode of the reference controller
+    (reference: cmd/edl/edl.go:31-50 in-cluster config path)."""
+    from edl_tpu.cluster.kube import KubeApi, KubeCluster, KubeJobSource
+    from edl_tpu.controller.controller import Controller
+    from edl_tpu.scheduler.autoscaler import Autoscaler
+
+    api = KubeApi(args.kube_url) if args.kube_url else KubeApi.from_env()
+    cluster = KubeCluster(api, worker_image=args.worker_image)
+    controller = Controller(
+        cluster,
+        autoscaler=Autoscaler(
+            cluster,
+            max_load_desired=args.max_load_desired,
+            use_native=not args.no_native_scheduler,
+        ),
+    )
+    source = KubeJobSource(cluster, args.namespace)
+    log.info(
+        "controller started (kube mode)",
+        api=api.base_url,
+        namespace=args.namespace or "<all>",
+        max_load_desired=args.max_load_desired,
+    )
+
+    published: dict = {}  # last status pushed per job (dirty check)
+
+    def _status_key(job):
+        st = job.status
+        return (
+            st.phase.value, st.reason, st.parallelism, st.reshard_count,
+            st.last_reshard_stall_s, st.worker.state.value,
+            st.worker.replicas, st.worker.ready_replicas,
+            st.worker.succeeded, st.worker.failed, st.master.state.value,
+            st.master.ready_replicas,
+        )
+
+    i = 0
+    while args.iterations is None or i < args.iterations:
+        # informer-poll analog (reference: WatchTrainingJobs
+        # pkg/controller.go:79-108); a transient API error must not kill
+        # the daemon — retry next tick
+        try:
+            source.poll(
+                controller.on_add, controller.on_update, controller.on_delete
+            )
+        except Exception as e:
+            log.error("trainingjob poll failed", error=str(e))
+        try:
+            controller.autoscaler.tick()
+            controller.step()
+        except Exception as e:
+            log.error("control tick failed", error=str(e))
+        for u in list(controller.updaters.values()):
+            key = _status_key(u.job)
+            if published.get(u.job.name) == key:
+                continue  # unchanged: don't spam the status subresource
+            try:
+                cluster.update_training_job_status(u.job)
+                published[u.job.name] = key
+            except Exception as e:
+                log.error("status update failed", job=u.job.name, error=str(e))
+        published = {
+            name: v for name, v in published.items()
+            if name in controller.updaters
+        }
+        i += 1
+        if args.iterations is not None and i >= args.iterations:
+            break
+        time.sleep(args.tick_s)
+    return 0
+
+
 def run_controller(args) -> int:
     """The daemon main loop (reference: Controller.Run pkg/controller.go:64-76
     + the autoscaler 5 s ticker pkg/autoscaler.go:451-485), run
@@ -89,6 +165,14 @@ def run_controller(args) -> int:
     from edl_tpu.controller.controller import Controller
     from edl_tpu.scheduler.autoscaler import Autoscaler
 
+    if args.kube:
+        return run_controller_kube(args)
+    if not args.store:
+        print(
+            "error: --store is required (or pass --kube for in-cluster mode)",
+            file=sys.stderr,
+        )
+        return 2
     store = JobStore(args.store)
     cluster = _build_cluster(args)
     controller = Controller(
@@ -276,7 +360,34 @@ def build_parser() -> argparse.ArgumentParser:
     sub = p.add_subparsers(dest="cmd", required=True)
 
     c = sub.add_parser("controller", help="run the controller daemon")
-    _add_store(c)
+    c.add_argument(
+        "--store",
+        default=None,
+        help="job store (spool) directory for the synthetic-fleet mode",
+    )
+    c.add_argument(
+        "--kube",
+        action="store_true",
+        help="in-cluster mode: source TrainingJobs from the CRD and drive "
+        "real child resources via the Kubernetes API (cluster/kube.py)",
+    )
+    c.add_argument(
+        "--kube-url",
+        default=None,
+        help="API server URL (default: in-cluster service account, "
+        "or $EDL_KUBE_URL)",
+    )
+    c.add_argument(
+        "--namespace",
+        default="",
+        help="kube mode: restrict the TrainingJob watch to one namespace",
+    )
+    c.add_argument(
+        "--worker-image",
+        default="edl-tpu/worker:latest",
+        help="kube mode: image for worker/coordinator pods when a job "
+        "spec omits one",
+    )
     c.add_argument(
         "--max-load-desired",
         type=float,

@@ -1,0 +1,45 @@
+"""Coordinator pod entrypoint — run the per-job coordinator service.
+
+The coordinator pod is the master-pod analog (reference: master
+ReplicaSet + etcd sidecar, pkg/jobparser.go:167-227): one per job,
+owning membership, KV, barriers, and the elastic task queue. This
+wrapper resolves/builds the native server (native/coordinator) and
+execs it, so the container's PID-1 signal handling applies to the
+server itself.
+
+Used by the KubeCluster coordinator Deployment
+(edl_tpu/cluster/kube.py) and handy for manual bring-up:
+
+    python -m edl_tpu.runtime.coordinator_main --port 7164
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="edl-coordinator")
+    ap.add_argument("--port", type=int, default=7164)
+    ap.add_argument(
+        "--member-ttl", type=float, default=10.0,
+        help="seconds without heartbeat before a member is reaped",
+    )
+    a = ap.parse_args(argv)
+
+    from edl_tpu.runtime.coordinator import _BIN_PATH, ensure_native_built
+
+    if not ensure_native_built():
+        print("native coordinator unavailable (no toolchain?)", file=sys.stderr)
+        return 1
+    os.execv(
+        _BIN_PATH,
+        [_BIN_PATH, "--port", str(a.port), "--member-ttl", str(a.member_ttl)],
+    )
+    return 0  # unreachable
+
+
+if __name__ == "__main__":
+    sys.exit(main())
