@@ -110,3 +110,39 @@ def test_ctr_learns_auc(cpu_devices):
     logits = ctr.forward(host_params, eval_b["dense"], eval_b["sparse"])
     auc = float(ctr.batch_auc(jnp.asarray(logits), jnp.asarray(eval_b["label"])))
     assert auc > 0.75, f"AUC {auc} did not learn the synthetic signal"
+
+
+def test_to_host_chunked_large_leaf(cpu_devices, monkeypatch):
+    """Large single-device leaves stream through the chunked path and
+    must land bit-identical, including a ragged final chunk."""
+    monkeypatch.setattr(shd, "_CHUNK_BYTES", 1 << 10)  # force chunking
+    monkeypatch.setattr(shd, "_CHUNK_WINDOW", 3)
+    dev = jax.devices()[0]
+    rng = np.random.RandomState(0)
+    big_odd = rng.randn(1001, 7).astype(np.float32)  # ragged last chunk
+    big_even = rng.randn(512, 8).astype(np.float32)
+    tree = {
+        "a": jax.device_put(big_odd, dev),
+        "b": jax.device_put(big_even, dev),
+        "small": jax.device_put(np.float32(3.5), dev),
+        "none": None,
+        "np_leaf": np.arange(4),
+    }
+    host = shd.to_host(tree)
+    np.testing.assert_array_equal(host["a"], big_odd)
+    np.testing.assert_array_equal(host["b"], big_even)
+    assert host["small"] == np.float32(3.5)
+    assert host["none"] is None
+    np.testing.assert_array_equal(host["np_leaf"], np.arange(4))
+
+
+def test_to_host_sharded_leaves_fetch_whole(cpu_devices, monkeypatch):
+    """Sharded arrays must bypass chunking (slicing would insert
+    collectives) and still round-trip exactly."""
+    monkeypatch.setattr(shd, "_CHUNK_BYTES", 1 << 10)
+    plan = MeshPlan.data_parallel(8)
+    mesh = plan.build()
+    x = np.random.RandomState(1).randn(64, 128).astype(np.float32)
+    sharded = shd.shard_tree(x, mesh, P("dp"))
+    assert len(sharded.sharding.device_set) == 8
+    np.testing.assert_array_equal(shd.to_host(sharded), x)
