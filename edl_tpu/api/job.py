@@ -186,6 +186,17 @@ class TrainingJob:
     status: TrainingJobStatus = field(default_factory=TrainingJobStatus)
     labels: Dict[str, str] = field(default_factory=dict)
 
+    @property
+    def qualified_name(self) -> str:
+        """Collision-free identity across namespaces. Bare name in the
+        default namespace (so single-namespace callers and logs stay
+        readable), ``namespace/name`` elsewhere — same-named jobs in
+        different namespaces must not share controller/autoscaler
+        state."""
+        if self.namespace in ("", "default"):
+            return self.name
+        return f"{self.namespace}/{self.name}"
+
     # -- predicates (reference: pkg/resource/training_job.go:189-207) ------
 
     def elastic(self) -> bool:

@@ -138,13 +138,17 @@ def run_controller_kube(args) -> int:
             log.error("control tick failed", error=str(e))
         for u in list(controller.updaters.values()):
             key = _status_key(u.job)
-            if published.get(u.job.name) == key:
+            if published.get(u.job.qualified_name) == key:
                 continue  # unchanged: don't spam the status subresource
             try:
                 cluster.update_training_job_status(u.job)
-                published[u.job.name] = key
+                published[u.job.qualified_name] = key
             except Exception as e:
-                log.error("status update failed", job=u.job.name, error=str(e))
+                log.error(
+                    "status update failed",
+                    job=u.job.qualified_name,
+                    error=str(e),
+                )
         published = {
             name: v for name, v in published.items()
             if name in controller.updaters
@@ -165,7 +169,7 @@ def run_controller(args) -> int:
     from edl_tpu.controller.controller import Controller
     from edl_tpu.scheduler.autoscaler import Autoscaler
 
-    if args.kube:
+    if args.kube or args.kube_url:
         return run_controller_kube(args)
     if not args.store:
         print(

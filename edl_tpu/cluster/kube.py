@@ -442,7 +442,15 @@ class KubeCluster(Cluster):
                 },
             },
         }
-        obj = self.api.post(_deploy_path(plan.namespace), manifest)
+        try:
+            obj = self.api.post(_deploy_path(plan.namespace), manifest)
+        except KubeApiError as e:
+            if e.status != 409:
+                raise
+            # Deployment survives from a half-finished prior attempt
+            # (e.g. the Service POST failed mid-create); fall through
+            # and repair the Service below.
+            obj = self.api.get(_deploy_path(plan.namespace, plan.name))
         # stable DNS name for worker discovery (etcd-lookup analog,
         # reference: docker/paddle_k8s:125-132 locates master by label)
         svc = {
@@ -531,22 +539,38 @@ class KubeCluster(Cluster):
     #    pkg/controller.go:79-108, poll-based) -----------------------------
 
     def list_training_jobs(self, namespace: str = "") -> List[TrainingJob]:
+        return self.list_training_jobs_with_broken(namespace)[0]
+
+    def list_training_jobs_with_broken(
+        self, namespace: str = ""
+    ) -> Tuple[List[TrainingJob], List[Tuple[str, str]]]:
+        """List CRs, also returning the (namespace, name) keys of items
+        that exist but failed to parse. The watch source needs those:
+        an unparseable CR (schema drift, a bad kubectl edit) must read
+        as "still present, currently unreadable" — if it were simply
+        omitted, the poll diff would report a deletion and the
+        controller would tear down the live job over a parse error."""
         path = (
             _tj_path(namespace)
             if namespace
             else f"/apis/{TJ_GROUP}/{TJ_VERSION}/{TJ_PLURAL}"
         )
-        out = []
+        out: List[TrainingJob] = []
+        broken: List[Tuple[str, str]] = []
         for item in self.api.get(path).get("items", []):
+            meta = item.get("metadata", {})
             try:
                 out.append(TrainingJob.from_dict(item))
             except Exception as e:
+                broken.append(
+                    (meta.get("namespace", "default"), meta.get("name", ""))
+                )
                 log.error(
-                    "skipping unparseable TrainingJob",
-                    name=item.get("metadata", {}).get("name"),
+                    "unparseable TrainingJob (keeping existing state)",
+                    name=meta.get("name"),
                     error=str(e),
                 )
-        return out
+        return out, broken
 
     def update_training_job_status(self, job: TrainingJob) -> None:
         """Publish observed status to the CRD status subresource
@@ -594,10 +618,16 @@ class KubeJobSource:
         on_update: Callable[[TrainingJob], None],
         on_delete: Callable[[TrainingJob], None],
     ) -> None:
-        current = {
-            (j.namespace, j.name): j
-            for j in self.cluster.list_training_jobs(self.namespace)
-        }
+        jobs, broken = self.cluster.list_training_jobs_with_broken(
+            self.namespace
+        )
+        current = {(j.namespace, j.name): j for j in jobs}
+        # An unparseable CR is present but unreadable: keep its last
+        # good state so it neither fires a spurious delete (tearing
+        # down the live job) nor a spurious update.
+        for key in broken:
+            if key in self._seen and key not in current:
+                current[key] = self._seen[key]
         for key in sorted(set(current) - set(self._seen)):
             on_add(current[key])
         for key in sorted(set(current) & set(self._seen)):

@@ -65,16 +65,16 @@ class Controller:
         """reference: onAdd parses + creates child resources and notifies
         the autoscaler (pkg/controller.go:110-148); here resource creation
         is delegated to the updater's state machine."""
-        if job.name in self.updaters:
+        if job.qualified_name in self.updaters:
             return
-        log.info("job added", job=job.name)
+        log.info("job added", job=job.qualified_name)
         updater = JobUpdater(job, self.cluster, self.parser)
-        self.updaters[job.name] = updater
+        self.updaters[job.qualified_name] = updater
         updater.step()  # parse + begin creating
         self.autoscaler.on_add(job)
 
     def on_update(self, job: TrainingJob) -> None:
-        u = self.updaters.get(job.name)
+        u = self.updaters.get(job.qualified_name)
         if u is None:
             self.on_add(job)
             return
@@ -82,11 +82,11 @@ class Controller:
         self.autoscaler.on_update(job)
 
     def on_delete(self, job: TrainingJob) -> None:
-        u = self.updaters.pop(job.name, None)
+        u = self.updaters.pop(job.qualified_name, None)
         if u is not None:
             u.delete()
         self.autoscaler.on_del(job)
-        log.info("job deleted", job=job.name)
+        log.info("job deleted", job=job.qualified_name)
 
     def _on_scale(self, job_name: str, new_parallelism: int) -> None:
         u = self.updaters.get(job_name)
@@ -97,9 +97,18 @@ class Controller:
 
     def step(self) -> None:
         """One convert pass over all updaters (the 10 s ticker analog,
-        reference: trainingJobUpdater.go:471-478)."""
+        reference: trainingJobUpdater.go:471-478). Errors are isolated
+        per updater: one job that fails every tick (bad manifest,
+        cluster 4xx) must not starve reconciliation of the others."""
         for u in list(self.updaters.values()):
-            u.step()
+            try:
+                u.step()
+            except Exception as e:
+                log.error(
+                    "updater step failed",
+                    job=u.job.qualified_name,
+                    error=str(e),
+                )
 
     def run(self, updater_interval_s: float = 1.0) -> None:
         """Run autoscaler + updater loops in threads
@@ -127,5 +136,7 @@ class Controller:
     # -- convenience -------------------------------------------------------
 
     def phase_of(self, job_name: str) -> JobPhase:
+        """job_name is the qualified name (bare name in the default
+        namespace)."""
         u = self.updaters.get(job_name)
         return u.phase if u else JobPhase.NONE

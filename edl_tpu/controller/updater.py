@@ -109,6 +109,12 @@ class JobUpdater:
             cplan = self.parser.parse_to_coordinator(self.job)
             try:
                 coord = self.cluster.get_coordinator(ns, cplan.name)
+                if coord.endpoint.endswith(":0"):
+                    # Deployment exists but the paired Service is
+                    # missing (a prior create died between the two
+                    # POSTs): re-run create, which is idempotent per
+                    # resource and fills in whichever half is absent.
+                    coord = self.cluster.create_coordinator(cplan)
             except KeyError:
                 coord = self.cluster.create_coordinator(cplan)
             self.job.status.master.state = ResourceState.CREATING

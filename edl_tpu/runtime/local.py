@@ -60,7 +60,7 @@ class LocalJobRunner:
         if hasattr(cluster, "scale_listeners"):
             cluster.scale_listeners.append(self._on_scale)
             self._attached = True
-        u = controller.updaters.get(job.name)
+        u = controller.updaters.get(job.qualified_name)
         if u is not None:
             u.runtime_attached = True  # this runner reports reshard stalls
         self.trainer.start(init_params, n_workers=group.parallelism)
@@ -74,7 +74,7 @@ class LocalJobRunner:
             except ValueError:
                 pass
             self._attached = False
-        u = self.controller.updaters.get(self.job.name)
+        u = self.controller.updaters.get(self.job.qualified_name)
         if u is not None:
             u.runtime_attached = False
 
@@ -83,7 +83,7 @@ class LocalJobRunner:
             self.trainer.request_rescale(parallelism)
 
     def _reshard_done(self, ev: ReshardEvent) -> None:
-        u = self.controller.updaters.get(self.job.name)
+        u = self.controller.updaters.get(self.job.qualified_name)
         if u is not None:
             u.on_reshard_done(ev.stall_s)
 

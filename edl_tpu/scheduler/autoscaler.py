@@ -217,7 +217,7 @@ def scale_all_jobs_dry_run(
 
         def dry_run(j: JobState, is_down: bool) -> None:
             nonlocal no_change
-            name = j.config.name
+            name = j.config.qualified_name
             additional = scale_dry_run(
                 r, j, diff.get(name, 0), max_load_desired, is_down, policy
             )
@@ -292,10 +292,10 @@ class Autoscaler:
         """reference: updateJobList pkg/autoscaler.go:383-402."""
         if ev.type in (Event.Type.ADD, Event.Type.UPDATE):
             j = JobState(config=ev.job)
-            self.jobs[ev.job.name] = j
+            self.jobs[ev.job.qualified_name] = j
             return self._retrieve_group(j)
         elif ev.type == Event.Type.DEL:
-            self.jobs.pop(ev.job.name, None)
+            self.jobs.pop(ev.job.qualified_name, None)
         return True
 
     def _retrieve_group(self, j: JobState) -> bool:
@@ -375,7 +375,7 @@ class Autoscaler:
             candidates = [
                 j
                 for j in candidates
-                if now - self._last_rescale.get(j.config.name, -1e18)
+                if now - self._last_rescale.get(j.config.qualified_name, -1e18)
                 >= self.rescale_cooldown_s
             ]
         diff = None

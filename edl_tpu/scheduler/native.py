@@ -117,4 +117,4 @@ def plan_native(
     if rc != 0:
         log.warn("native planner returned error", rc=rc)
         return None
-    return {jobs[i].config.name: int(out[i]) for i in range(n)}
+    return {jobs[i].config.qualified_name: int(out[i]) for i in range(n)}

@@ -334,3 +334,113 @@ def test_control_plane_end_to_end_over_kube(server, cluster):
         server.get_object("apps/v1", "deployments", "default", "e2e-coordinator")
         is None
     )
+
+
+def test_job_source_keeps_unparseable_job(server, cluster):
+    """A CR that stops parsing (bad kubectl edit, schema drift) must not
+    be diffed as a deletion — that would tear down the live job."""
+    src = KubeJobSource(cluster)
+    events = []
+    cb = lambda kind: lambda j: events.append((kind, j.name))  # noqa: E731
+
+    good = {
+        "metadata": {"name": "a", "namespace": "default"},
+        "spec": {"worker": {"min_replicas": 1, "max_replicas": 2}},
+    }
+    server.create_training_job(good)
+    src.poll(cb("add"), cb("upd"), cb("del"))
+    assert events == [("add", "a")]
+
+    broken = {
+        "metadata": {"name": "a", "namespace": "default"},
+        "spec": {
+            "worker": {
+                "min_replicas": 1,
+                "max_replicas": 2,
+                "resources": {"requests": {"cpu": "not-a-number"}},
+            }
+        },
+    }
+    server.create_training_job(broken)  # overwrite in place
+    events.clear()
+    src.poll(cb("add"), cb("upd"), cb("del"))
+    assert events == []  # neither delete nor update
+
+    server.create_training_job(good)  # repaired
+    events.clear()
+    src.poll(cb("add"), cb("upd"), cb("del"))
+    assert events == []  # same spec as last good state
+
+    server.delete_training_job("default", "a")
+    events.clear()
+    src.poll(cb("add"), cb("upd"), cb("del"))
+    assert events == [("del", "a")]  # a real deletion still fires
+
+
+def test_controller_step_isolates_failing_updater(cluster):
+    """One persistently failing updater must not starve the others
+    (reference runs each updater in its own goroutine,
+    trainingJobUpdater.go:74)."""
+    from edl_tpu.controller.controller import Controller
+
+    ctl = Controller(cluster)
+    ctl.on_add(_job("good"))
+    ctl.on_add(_job("bad"))
+
+    calls = []
+    ctl.updaters["good"].step = lambda: calls.append("good")
+
+    def _boom():
+        calls.append("bad")
+        raise RuntimeError("create failed: 422")
+
+    ctl.updaters["bad"].step = _boom
+    ctl.step()  # must not raise
+    assert calls.count("bad") == 1 and calls.count("good") == 1
+
+
+def test_same_name_jobs_in_two_namespaces_do_not_collide(server, cluster):
+    from edl_tpu.controller.controller import Controller
+
+    ctl = Controller(cluster)
+    src = KubeJobSource(cluster)
+    for ns in ("team-a", "team-b"):
+        server.create_training_job(
+            {
+                "metadata": {"name": "train", "namespace": ns},
+                "spec": {
+                    "fault_tolerant": True,
+                    "worker": {
+                        "min_replicas": 1,
+                        "max_replicas": 2,
+                        "entrypoint": "python t.py",
+                    },
+                },
+            }
+        )
+    src.poll(ctl.on_add, ctl.on_update, ctl.on_delete)
+    assert set(ctl.updaters) == {"team-a/train", "team-b/train"}
+    assert len(ctl.autoscaler._events.queue) == 2
+
+    # deleting one namespace's job leaves the other reconciled
+    server.delete_training_job("team-a", "train")
+    src.poll(ctl.on_add, ctl.on_update, ctl.on_delete)
+    assert set(ctl.updaters) == {"team-b/train"}
+
+
+def test_coordinator_create_repairs_missing_service(server, cluster):
+    """A create that died between the Deployment and Service POSTs is
+    repaired by the updater's get-or-create on the next tick."""
+    parser = JobParser()
+    job = _job("demo")
+    parser.validate(job)
+    plan = parser.parse_to_coordinator(job)
+    cluster.create_coordinator(plan)
+    # simulate the torn create: service never landed
+    cluster.api.delete(f"/api/v1/namespaces/default/services/{plan.name}")
+    got = cluster.get_coordinator("default", plan.name)
+    assert got.endpoint.endswith(":0")  # detectably broken
+
+    repaired = cluster.create_coordinator(plan)  # 409 on Deployment is OK
+    assert not repaired.endpoint.endswith(":0")
+    assert not cluster.get_coordinator("default", plan.name).endpoint.endswith(":0")
