@@ -18,6 +18,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from edl_tpu.ops.embedding import embedding_lookup
+
 N_DENSE = 13  # Criteo dense feature count
 N_SPARSE = 26  # Criteo categorical slots
 DEFAULT_EMBEDDING = 16  # reference default 10 (train.py:46-49); 16 tiles MXU lanes
@@ -59,7 +61,7 @@ def init_params(
 
 def forward(params, dense: jnp.ndarray, sparse: jnp.ndarray) -> jnp.ndarray:
     """Logits for a batch. dense [B, 13] float, sparse [B, 26] int32 ids."""
-    emb = jnp.take(params["embedding"], sparse, axis=0)  # [B, 26, E]
+    emb = embedding_lookup(params["embedding"], sparse)  # [B, 26, E]
     x = jnp.concatenate([emb.reshape(emb.shape[0], -1), dense], axis=-1)
     for layer in params["mlp"]:
         x = jax.nn.relu(x @ layer["w"] + layer["b"])

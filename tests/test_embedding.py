@@ -1,0 +1,203 @@
+"""embedding_lookup: the sorted block-matmul backward must be exact
+against the plain scatter-add for every id distribution, including the
+adversarial ones that trigger the second window and the full fallback
+(reference workload: the shared CTR embedding table,
+example/ctr/ctr/train.py:46-64)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import edl_tpu.ops.embedding as emb
+from edl_tpu.ops.embedding import embedding_lookup
+
+
+def _grad_pair(table, ids, ct_dtype=jnp.float32):
+    """(custom bwd, reference scatter bwd) for sum(lookup * w)."""
+    w = jnp.asarray(
+        np.random.RandomState(7).randn(*ids.shape, table.shape[1])
+    ).astype(ct_dtype)
+
+    def loss_custom(t):
+        return jnp.sum(embedding_lookup(t, ids).astype(ct_dtype) * w)
+
+    def loss_ref(t):
+        return jnp.sum(jnp.take(t, ids, axis=0).astype(ct_dtype) * w)
+
+    return jax.grad(loss_custom)(table), jax.grad(loss_ref)(table)
+
+
+def _check(vocab, e, ids, tol=2e-5, dtype=jnp.float32):
+    table = jnp.asarray(
+        np.random.RandomState(0).randn(vocab, e).astype(np.float32)
+    ).astype(dtype)
+    got, ref = _grad_pair(table, ids)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(ref, np.float32), atol=tol
+    )
+
+
+def test_forward_matches_take(cpu_devices):
+    table = jnp.asarray(np.random.RandomState(0).randn(100, 8), jnp.float32)
+    ids = jnp.asarray([[3, 7], [99, 0]], jnp.int32)
+    np.testing.assert_array_equal(
+        np.asarray(embedding_lookup(table, ids)),
+        np.asarray(jnp.take(table, ids, axis=0)),
+    )
+
+
+def test_small_n_uses_plain_path_exact(cpu_devices):
+    ids = jnp.asarray(np.random.RandomState(1).randint(0, 500, 64), jnp.int32)
+    _check(500, 8, ids)
+
+
+def test_fast_path_uniform_ids(cpu_devices, monkeypatch):
+    monkeypatch.setattr(emb, "MIN_FAST_IDS", 1)
+    monkeypatch.setattr(emb, "BLOCK_ROWS", 64)
+    monkeypatch.setattr(emb, "VOCAB_WINDOW", 256)
+    ids = jnp.asarray(
+        np.random.RandomState(2).randint(0, 4096, 1000), jnp.int32
+    )
+    _check(4096, 16, ids)
+
+
+def test_fast_path_zipf_duplicates(cpu_devices, monkeypatch):
+    monkeypatch.setattr(emb, "MIN_FAST_IDS", 1)
+    monkeypatch.setattr(emb, "BLOCK_ROWS", 64)
+    monkeypatch.setattr(emb, "VOCAB_WINDOW", 256)
+    rng = np.random.RandomState(3)
+    ids = jnp.asarray(
+        np.minimum(rng.zipf(1.3, 1000) - 1, 4095).astype(np.int32)
+    )
+    _check(4096, 16, ids)
+
+
+def test_fast_path_second_window(cpu_devices, monkeypatch):
+    """Each block spans just under two windows: window two must fire
+    and must not double-count rows at the vocab-end clamp."""
+    monkeypatch.setattr(emb, "MIN_FAST_IDS", 1)
+    monkeypatch.setattr(emb, "BLOCK_ROWS", 64)
+    monkeypatch.setattr(emb, "VOCAB_WINDOW", 128)
+    rng = np.random.RandomState(4)
+    # ids clustered so a sorted 64-row block spans ~200 vocab (>128, <256)
+    base = np.repeat(np.arange(0, 4096, 200), 49)[:1000]
+    ids = jnp.asarray(
+        np.minimum(base + rng.randint(0, 190, 1000), 4095).astype(np.int32)
+    )
+    _check(4096, 16, ids)
+
+
+def test_fast_path_vocab_end_clamp(cpu_devices, monkeypatch):
+    """All ids piled at the end of vocab: both windows clamp to
+    vocab - TV; rows must be counted exactly once."""
+    monkeypatch.setattr(emb, "MIN_FAST_IDS", 1)
+    monkeypatch.setattr(emb, "BLOCK_ROWS", 64)
+    monkeypatch.setattr(emb, "VOCAB_WINDOW", 128)
+    rng = np.random.RandomState(5)
+    ids = jnp.asarray(rng.randint(4096 - 140, 4096, 1000).astype(np.int32))
+    _check(4096, 16, ids)
+
+
+def test_adversarial_span_falls_back(cpu_devices, monkeypatch):
+    """A block spanning > 2 windows must take the scatter fallback and
+    stay exact."""
+    monkeypatch.setattr(emb, "MIN_FAST_IDS", 1)
+    monkeypatch.setattr(emb, "BLOCK_ROWS", 64)
+    monkeypatch.setattr(emb, "VOCAB_WINDOW", 128)
+    rng = np.random.RandomState(6)
+    ids = jnp.asarray(rng.randint(0, 4096, 1000).astype(np.int32))
+    # uniform over 4096 with 64-row blocks spans ~4096 >> 256: fallback
+    _check(4096, 16, ids)
+
+
+def test_bf16_table_close_to_f32_scatter(cpu_devices, monkeypatch):
+    """bf16 table: our f32 accumulation is at least as accurate as the
+    scatter (which accumulates in bf16), so compare against the f32
+    reference with bf16 rounding tolerance."""
+    monkeypatch.setattr(emb, "MIN_FAST_IDS", 1)
+    monkeypatch.setattr(emb, "BLOCK_ROWS", 64)
+    monkeypatch.setattr(emb, "VOCAB_WINDOW", 256)
+    rng = np.random.RandomState(8)
+    ids = jnp.asarray(rng.randint(0, 4096, 1000).astype(np.int32))
+    table = jnp.asarray(rng.randn(4096, 16), jnp.float32)
+    got_bf16, _ = _grad_pair(table.astype(jnp.bfloat16), ids)
+    _, ref_f32 = _grad_pair(table, ids)
+    np.testing.assert_allclose(
+        np.asarray(got_bf16, np.float32),
+        np.asarray(ref_f32, np.float32),
+        atol=0.25,  # one bf16 ulp of the accumulated sums
+    )
+
+
+def test_out_of_range_ids_do_not_corrupt_valid_rows(cpu_devices, monkeypatch):
+    """A stray negative / too-large id (data-pipeline padding sentinel)
+    must not shift the gradient of the other rows in its sort block; the
+    op clamps OOB ids to [0, V-1] in both directions."""
+    monkeypatch.setattr(emb, "MIN_FAST_IDS", 1)
+    monkeypatch.setattr(emb, "BLOCK_ROWS", 64)
+    monkeypatch.setattr(emb, "VOCAB_WINDOW", 128)
+    vocab, e = 4096, 16
+    rng = np.random.RandomState(10)
+    good = rng.randint(0, 130, 998).astype(np.int32)  # one narrow window
+    ids = jnp.asarray(np.concatenate([[-5, 5000], good]).astype(np.int32))
+    table = jnp.asarray(rng.randn(vocab, e).astype(np.float32))
+    got, _ = _grad_pair(table, ids)
+    clamped = jnp.clip(ids, 0, vocab - 1)
+    _, ref = _grad_pair(table, clamped)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), atol=2e-5
+    )
+
+
+def test_padding_does_not_force_fallback(cpu_devices, monkeypatch):
+    """n not a multiple of BLOCK_ROWS with all ids far below vocab-1:
+    the pad rows must not stretch the last block's span into the `bad`
+    fallback. Detected by checking the fast path stays exact AND cheap —
+    here simply that results match with ids confined to one window
+    (the old vocab-1 padding made (last - vstart) >= 2*TV)."""
+    monkeypatch.setattr(emb, "MIN_FAST_IDS", 1)
+    monkeypatch.setattr(emb, "BLOCK_ROWS", 64)
+    monkeypatch.setattr(emb, "VOCAB_WINDOW", 128)
+    rng = np.random.RandomState(11)
+    ids = jnp.asarray(rng.randint(0, 100, 1000).astype(np.int32))  # 1000 % 64 != 0
+    _check(4096, 16, ids)
+    # the regression was vocab-1 padding flipping `bad` at runtime:
+    # recompute the flag exactly as _blocked_grad does, with real-id pad
+    n, bn, tv = 1000, 64, 128
+    npad = -(-n // bn) * bn
+    sids = np.sort(np.asarray(ids))
+    sids = np.concatenate([sids, np.full(npad - n, sids[-1])])
+    blocks = sids.reshape(-1, bn)
+    vstart = np.minimum(blocks[:, 0], 4096 - tv)
+    assert not np.any((blocks[:, -1] - vstart) >= 2 * tv)
+
+
+def test_under_jit_and_dp_mesh(cpu_devices, monkeypatch):
+    """The op must compile and stay exact inside a pjit'd train step on
+    the virtual mesh (the bench path)."""
+    import optax
+
+    from edl_tpu.models import ctr
+    from edl_tpu.parallel.mesh import MeshPlan
+    from edl_tpu.train.trainer import (
+        TrainState,
+        global_batch,
+        make_train_step,
+        shard_state,
+    )
+
+    monkeypatch.setattr(emb, "MIN_FAST_IDS", 1)
+    monkeypatch.setattr(emb, "BLOCK_ROWS", 64)
+    monkeypatch.setattr(emb, "VOCAB_WINDOW", 256)
+    plan = MeshPlan.data_parallel(8)
+    mesh = plan.build()
+    params = ctr.init_params(jax.random.PRNGKey(0), vocab=2048, emb=8)
+    tx = optax.adam(1e-2)
+    state = shard_state(TrainState.create(params, tx), plan, mesh)
+    step = make_train_step(ctr.loss_fn, tx, plan, mesh)
+    rng = np.random.RandomState(9)
+    for _ in range(3):
+        b = ctr.synthetic_batch(rng, 256, vocab=2048)
+        state, m = step(state, global_batch(b, plan, mesh))
+    assert np.isfinite(float(m["loss"]))
