@@ -219,3 +219,52 @@ class QueueBatcher:
             k: _np.concatenate([p[k] for p in pieces], axis=0)
             for k in pieces[0]
         }
+
+
+class StaticShardReader:
+    """Classic non-elastic data sharding: chunk ``i`` belongs to worker
+    ``i % n_workers`` (reference: example/fit_a_line/fluid/common.py:24-40
+    ``cluster_reader`` shards files by ``idx % trainers == trainer_id``).
+    No leases, no redelivery — membership is fixed for the life of the
+    job, the DistributeTranspiler-era mode (W3). Complements
+    :class:`ElasticDataQueue`, which is the elastic/fault-tolerant mode.
+    """
+
+    def __init__(
+        self,
+        n_samples: int,
+        chunk_size: int,
+        n_workers: int,
+        worker_id: int,
+    ):
+        if not 0 <= worker_id < n_workers:
+            raise ValueError(f"worker_id {worker_id} not in [0, {n_workers})")
+        if n_samples <= 0 or chunk_size <= 0:
+            raise ValueError("n_samples and chunk_size must be positive")
+        self.n_samples = n_samples
+        self.chunk_size = chunk_size
+        self.n_workers = n_workers
+        self.worker_id = worker_id
+
+    def chunks(self) -> List[Task]:
+        """This worker's chunk tasks, in deterministic order."""
+        out: List[Task] = []
+        n_chunks = -(-self.n_samples // self.chunk_size)
+        for i in range(self.worker_id, n_chunks, self.n_workers):
+            start = i * self.chunk_size
+            out.append(
+                Task(
+                    task_id=i,
+                    start=start,
+                    end=min(start + self.chunk_size, self.n_samples),
+                    epoch=0,
+                )
+            )
+        return out
+
+    def epoch_indices(self) -> List[int]:
+        """Flat sample indices this worker owns, one epoch."""
+        idx: List[int] = []
+        for t in self.chunks():
+            idx.extend(range(t.start, t.end))
+        return idx

@@ -87,3 +87,32 @@ def test_poison_task_dies_after_max_failures():
         time.sleep(0.02)
     assert q.progress()["dead"] == 1
     assert q.done() or q.progress()["todo"] == 0
+
+
+def test_static_shard_reader_partition():
+    """Chunk i belongs to worker i % N: shards are disjoint and cover
+    every sample exactly once (the cluster_reader contract, reference:
+    example/fit_a_line/fluid/common.py:24-40)."""
+    from edl_tpu.runtime.data import StaticShardReader
+
+    n, chunk, workers = 1000, 64, 3  # ragged final chunk
+    shards = [
+        StaticShardReader(n, chunk, workers, w).epoch_indices()
+        for w in range(workers)
+    ]
+    flat = sorted(i for s in shards for i in s)
+    assert flat == list(range(n))
+    # deterministic round-robin chunk ownership
+    r0 = StaticShardReader(n, chunk, workers, 0)
+    assert [t.task_id for t in r0.chunks()] == [0, 3, 6, 9, 12, 15]
+
+
+def test_static_shard_reader_validates():
+    import pytest as _pytest
+
+    from edl_tpu.runtime.data import StaticShardReader
+
+    with _pytest.raises(ValueError):
+        StaticShardReader(10, 2, 2, 2)
+    with _pytest.raises(ValueError):
+        StaticShardReader(0, 2, 2, 0)

@@ -89,3 +89,17 @@ def test_llama_fsdp_train(monkeypatch, capsys, cpu_devices):
         == 0
     )
     assert "ok" in capsys.readouterr().out
+
+
+def test_recognize_digits_static_shards(monkeypatch, capsys, cpu_devices):
+    assert (
+        _run_example(
+            monkeypatch,
+            "recognize_digits/train.py",
+            ["--samples", "512", "--epochs", "1", "--per-worker-batch", "16"],
+        )
+        == 0
+    )
+    out = capsys.readouterr().out
+    assert "phase=succeeded" in out
+    assert "fixed 4 workers" in out
