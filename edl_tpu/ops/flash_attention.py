@@ -15,9 +15,17 @@ hand-scheduling. Design:
   (bf16 MXU at full rate, f32 accumulation);
 - causal upper-triangle blocks are skipped via ``pl.when``.
 
-Measured on v5e (fenced timing): T=2048 d=128 h=16 — 8.5 ms vs
-9.2 ms XLA fused attention; T=16384 causal — 15.9 ms vs 29.2 ms XLA
-(causal block skipping wins at long context). Falls back to interpret mode off-TPU (same code path,
+Differentiable: custom_vjp with FlashAttention-2-style backward — the
+forward also emits the per-row logsumexp (lane-replicated [bh, T, 128]
+layout, the Mosaic minimum f32 tile); the backward runs two pallas
+sweeps, dQ (kv innermost) and dK/dV (q innermost, per-query-head then
+group-summed for GQA), with delta = rowsum(dO*O) precomputed in XLA.
+
+Measured on v5e (fenced timing): forward T=2048 d=128 h=16 — 8.5 ms vs
+9.2 ms XLA fused attention; T=16384 causal — 15.9 ms vs 29.2 ms XLA.
+Forward+backward (b=4 T=2048 h=16 kv=8): 15.4 ms vs 20.3 ms XLA;
+T=8192: 23.9 ms vs 50.2 ms XLA (causal block skipping compounds at
+long context). Falls back to interpret mode off-TPU (same code path,
 test-coverable on CPU).
 """
 
@@ -32,6 +40,38 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+LANES = 128  # min f32 tile lane width: row vectors (lse, delta) are
+# stored lane-replicated [bh, t, LANES] — Mosaic rejects (1, bq) blocks
+
+
+def _causal_live(q_start, k_start, block_q):
+    """Whether a (q block, k block) pair intersects the causal triangle.
+    Shared by all three kernels — the skip predicates must agree or the
+    gradient desynchronizes from the forward."""
+    return k_start <= q_start + block_q - 1
+
+
+def _scores(q, k, sm_scale):
+    """Scaled q·kᵀ block scores in f32 — the one matmul every kernel
+    shares; any change here changes forward AND backward together."""
+    return (
+        jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        * sm_scale
+    )
+
+
+def _causal_rc(q_start, k_start, block_q, block_k):
+    """(rows, cols) absolute-position iotas for the causal mask."""
+    rows = q_start + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    cols = k_start + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    return rows, cols
 
 
 def _flash_kernel(
@@ -39,15 +79,20 @@ def _flash_kernel(
     k_ref,
     v_ref,
     o_ref,
-    m_ref,
-    l_ref,
-    acc_ref,
-    *,
+    *rest,
     block_q: int,
     block_k: int,
     causal: bool,
     sm_scale: float,
+    with_lse: bool,
 ):
+    # lse is an output only on the residual-saving (training) path; the
+    # plain forward skips it — pallas can't DCE an unused output and the
+    # lane-replicated lse costs real HBM traffic
+    if with_lse:
+        lse_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        m_ref, l_ref, acc_ref = rest
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     n_k = pl.num_programs(2)
@@ -61,27 +106,16 @@ def _flash_kernel(
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     # causal: blocks entirely above the diagonal contribute nothing
-    live = True if not causal else k_start <= q_start + block_q - 1
+    live = True if not causal else _causal_live(q_start, k_start, block_q)
 
     @pl.when(live)
     def _compute():
         q = q_ref[0]  # [bq, d] native dtype
         k = k_ref[0]  # [bk, d]
         v = v_ref[0]
-        s = (
-            jax.lax.dot_general(
-                q, k, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            * sm_scale
-        )  # [bq, bk] f32
+        s = _scores(q, k, sm_scale)  # [bq, bk] f32
         if causal:
-            rows = q_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            cols = k_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
+            rows, cols = _causal_rc(q_start, k_start, block_q, block_k)
             s = jnp.where(rows >= cols, s, NEG_INF)
         m_prev = m_ref[:]
         blk_m = jnp.max(s, axis=1, keepdims=True)  # [bq, 1]
@@ -101,6 +135,261 @@ def _flash_kernel(
         o_ref[0] = (
             acc_ref[:] / jnp.maximum(l_ref[:], 1e-20)
         ).astype(o_ref.dtype)
+        if with_lse:
+            # logsumexp per query row — the backward's softmax residual
+            lse_ref[0] = jnp.broadcast_to(
+                m_ref[:] + jnp.log(jnp.maximum(l_ref[:], 1e-20)),
+                lse_ref.shape[1:],
+            )
+
+
+def _fwd_call(
+    qb, kb, vb, groups, block_q, block_k, causal, interpret, with_lse
+):
+    """Forward pallas call in flattened [B*H, T, d] layout → out or
+    (out, lse): lse is produced only when saving residuals for grad."""
+    bh, t, d = qb.shape
+    kernel = functools.partial(
+        _flash_kernel,
+        block_q=block_q,
+        block_k=block_k,
+        causal=causal,
+        sm_scale=1.0 / np.sqrt(d),
+        with_lse=with_lse,
+    )
+    o_spec = pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0))
+    o_shape = jax.ShapeDtypeStruct((bh, t, d), qb.dtype)
+    lse_spec = pl.BlockSpec(
+        (1, block_q, LANES), lambda bh, qi, ki: (bh, qi, 0)
+    )
+    lse_shape = jax.ShapeDtypeStruct((bh, t, LANES), jnp.float32)
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, t // block_q, t // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            # GQA: grouped query heads share a kv head — no repeat
+            pl.BlockSpec(
+                (1, block_k, d), lambda bh, qi, ki, g=groups: (bh // g, ki, 0)
+            ),
+            pl.BlockSpec(
+                (1, block_k, d), lambda bh, qi, ki, g=groups: (bh // g, ki, 0)
+            ),
+        ],
+        out_specs=[o_spec, lse_spec] if with_lse else o_spec,
+        out_shape=[o_shape, lse_shape] if with_lse else o_shape,
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),  # running max
+            pltpu.VMEM((block_q, 1), jnp.float32),  # running sum
+            pltpu.VMEM((block_q, d), jnp.float32),  # output accumulator
+        ],
+        interpret=interpret,
+    )(qb, kb, vb)
+
+
+def _bwd_dq_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    do_ref,
+    lse_ref,
+    delta_ref,
+    dq_ref,
+    acc_ref,
+    *,
+    block_q: int,
+    block_k: int,
+    causal: bool,
+    sm_scale: float,
+):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    n_k = pl.num_programs(2)
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    live = True if not causal else _causal_live(q_start, k_start, block_q)
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        s = _scores(q, k, sm_scale)
+        p = jnp.exp(s - lse_ref[0][:, :1])  # [bq, bk]
+        if causal:
+            rows, cols = _causal_rc(q_start, k_start, block_q, block_k)
+            p = jnp.where(rows >= cols, p, 0.0)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bq, bk]
+        ds = p * (dp - delta_ref[0][:, :1]) * sm_scale
+        acc_ref[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ki == n_k - 1)
+    def _finish():
+        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    do_ref,
+    lse_ref,
+    delta_ref,
+    dk_ref,
+    dv_ref,
+    dk_acc_ref,
+    dv_acc_ref,
+    *,
+    block_q: int,
+    block_k: int,
+    causal: bool,
+    sm_scale: float,
+):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)  # q innermost: dk/dv accumulate over the q sweep
+    n_q = pl.num_programs(2)
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
+
+    live = True if not causal else _causal_live(q_start, k_start, block_q)
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        s = _scores(q, k, sm_scale)  # [bq, bk]
+        p = jnp.exp(s - lse_ref[0][:, :1])
+        if causal:
+            rows, cols = _causal_rc(q_start, k_start, block_q, block_k)
+            p = jnp.where(rows >= cols, p, 0.0)
+        dv_acc_ref[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bk, d]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bq, bk]
+        ds = p * (dp - delta_ref[0][:, :1]) * sm_scale
+        dk_acc_ref[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bk, d]
+
+    @pl.when(qi == n_q - 1)
+    def _finish():
+        dk_ref[0] = dk_acc_ref[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc_ref[:].astype(dv_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(qb, kb, vb, groups, block_q, block_k, causal, interpret):
+    # primal (no-grad) path: lse-free kernel — no residual HBM traffic
+    return _fwd_call(
+        qb, kb, vb, groups, block_q, block_k, causal, interpret,
+        with_lse=False,
+    )
+
+
+def _flash_fwd(qb, kb, vb, groups, block_q, block_k, causal, interpret):
+    out, lse = _fwd_call(
+        qb, kb, vb, groups, block_q, block_k, causal, interpret,
+        with_lse=True,
+    )
+    return out, (qb, kb, vb, out, lse)
+
+
+def _flash_bwd(groups, block_q, block_k, causal, interpret, res, do):
+    qb, kb, vb, out, lse = res
+    bh, t, d = qb.shape
+    sm_scale = 1.0 / np.sqrt(d)
+    # delta_i = Σ_d dO_i · O_i — cheap rowwise reduce, stays in XLA,
+    # lane-replicated to match the lse layout
+    delta = jnp.broadcast_to(
+        jnp.sum(
+            do.astype(jnp.float32) * out.astype(jnp.float32),
+            axis=-1,
+            keepdims=True,
+        ),
+        (bh, t, LANES),
+    )
+
+    qspec = pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0))
+    rowspec = pl.BlockSpec((1, block_q, LANES), lambda bh, i, j: (bh, i, 0))
+    kv_q = pl.BlockSpec(
+        (1, block_k, d), lambda bh, i, j, g=groups: (bh // g, j, 0)
+    )
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel,
+            block_q=block_q,
+            block_k=block_k,
+            causal=causal,
+            sm_scale=sm_scale,
+        ),
+        grid=(bh, t // block_q, t // block_k),
+        in_specs=[qspec, kv_q, kv_q, qspec, rowspec, rowspec],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), qb.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qb, kb, vb, do, lse, delta)
+
+    # dk/dv: grid sweeps q innermost; outputs are per QUERY head, then
+    # group-summed to the kv heads (GQA) in f32
+    qspec2 = pl.BlockSpec((1, block_q, d), lambda bh, j, i: (bh, i, 0))
+    rowspec2 = pl.BlockSpec((1, block_q, LANES), lambda bh, j, i: (bh, i, 0))
+    kv_q2 = pl.BlockSpec(
+        (1, block_k, d), lambda bh, j, i, g=groups: (bh // g, j, 0)
+    )
+    kvspec_out = pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0))
+    dk_full, dv_full = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel,
+            block_q=block_q,
+            block_k=block_k,
+            causal=causal,
+            sm_scale=sm_scale,
+        ),
+        grid=(bh, t // block_k, t // block_q),
+        in_specs=[qspec2, kv_q2, kv_q2, qspec2, rowspec2, rowspec2],
+        out_specs=[kvspec_out, kvspec_out],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), jnp.float32),
+            jax.ShapeDtypeStruct((bh, t, d), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qb, kb, vb, do, lse, delta)
+    hkv = bh // groups
+    dk = dk_full.reshape(hkv, groups, t, d).sum(axis=1).astype(kb.dtype)
+    dv = dv_full.reshape(hkv, groups, t, d).sum(axis=1).astype(vb.dtype)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 @functools.partial(
@@ -118,7 +407,9 @@ def flash_attention(
     """q [B, T, H, d], k/v [B, T, KV, d] with H % KV == 0 (GQA) →
     [B, T, H, d]. T must divide by the (clamped) block sizes — check
     with :func:`flash_supported`, or pad upstream. Block defaults
-    (512, 512) measured fastest on v5e at T=2048, d=128."""
+    (512, 512) measured fastest on v5e at T=2048, d=128. Differentiable:
+    the FlashAttention-2-style backward (dQ sweep + dK/dV sweep pallas
+    kernels, logsumexp residual) is wired via custom_vjp."""
     b, t, h, d = q.shape
     hk = k.shape[2]
     if h % hk:
@@ -134,36 +425,7 @@ def flash_attention(
     qb = q.transpose(0, 2, 1, 3).reshape(b * h, t, d)
     kb = k.transpose(0, 2, 1, 3).reshape(b * hk, t, d)
     vb = v.transpose(0, 2, 1, 3).reshape(b * hk, t, d)
-    sm_scale = 1.0 / np.sqrt(d)
-    kernel = functools.partial(
-        _flash_kernel,
-        block_q=block_q,
-        block_k=block_k,
-        causal=causal,
-        sm_scale=sm_scale,
-    )
-    out = pl.pallas_call(
-        kernel,
-        grid=(b * h, t // block_q, t // block_k),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-            # GQA: grouped query heads share a kv head — no repeat
-            pl.BlockSpec(
-                (1, block_k, d), lambda bh, qi, ki, g=groups: (bh // g, ki, 0)
-            ),
-            pl.BlockSpec(
-                (1, block_k, d), lambda bh, qi, ki, g=groups: (bh // g, ki, 0)
-            ),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
-        scratch_shapes=[
-            pltpu.VMEM((block_q, 1), jnp.float32),  # running max
-            pltpu.VMEM((block_q, 1), jnp.float32),  # running sum
-            pltpu.VMEM((block_q, d), jnp.float32),  # output accumulator
-        ],
-        interpret=interpret,
-    )(qb, kb, vb)
+    out = _flash(qb, kb, vb, groups, block_q, block_k, causal, interpret)
     return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
 
