@@ -150,3 +150,87 @@ def to_host(tree) -> Any:
     return jax.tree_util.tree_unflatten(
         treedef, [np.asarray(x) for x in fetched]
     )
+
+
+def _dim0_parts(sh, shape) -> int:
+    """How many ways the target sharding splits dimension 0."""
+    if not shape:
+        return 1
+    try:
+        return max(1, shape[0] // sh.shard_shape(tuple(shape))[0])
+    except Exception:
+        return 1
+
+
+def stream_reshard(leaves, sh_leaves) -> list:
+    """Device → host → device as one overlapped pipeline — the host
+    fallback of the reshard protocol (the ``to_host`` + ``shard_tree``
+    pair collapsed so uploads of landed pieces overlap the remaining
+    downloads on a full-duplex link; stall → max(d2h, h2d), not sum).
+
+    Policies shared with :func:`to_host`: big SINGLE-device leaves are
+    row-split into ~``_CHUNK_BYTES`` pieces with at most
+    ``_CHUNK_WINDOW`` device→host copies in flight; multi-device
+    (sharded) leaves always move whole and shard-direct — slicing them
+    would compile a cross-device gather on the very mesh being
+    evacuated. Piece row counts are rounded up to the TARGET sharding's
+    dim-0 partition count so every per-piece ``device_put`` divides
+    evenly (an fsdp-sharded destination rejects ragged pieces).
+    """
+    schedule = []  # (leaf_idx, row_start, row_end) — None row = whole
+    for i, x in enumerate(leaves):
+        nbytes = getattr(x, "nbytes", 0)
+        shape = getattr(x, "shape", ())
+        rows = None
+        if nbytes > 2 * _CHUNK_BYTES and shape and shape[0] > 1 and (
+            _is_single_device(x)
+        ):
+            n = min(shape[0], max(2, nbytes // _CHUNK_BYTES))
+            rows = -(-shape[0] // n)
+            div = _dim0_parts(sh_leaves[i], shape)
+            if shape[0] % div == 0:
+                rows = -(-rows // div) * div  # piece splits evenly
+            else:  # ragged target split: give up on piecing this leaf
+                rows = None
+        if rows is None or rows >= shape[0]:
+            schedule.append((i, None, None))
+        else:
+            for s in range(0, shape[0], rows):
+                schedule.append((i, s, min(s + rows, shape[0])))
+
+    uploaded: dict = {}
+    pending: list = []  # (leaf_idx, device_piece)
+
+    def _land() -> None:
+        i, p = pending.pop(0)
+        h = np.asarray(p)  # blocks for THIS piece only
+        uploaded.setdefault(i, []).append(jax.device_put(h, sh_leaves[i]))
+
+    for i, s, e in schedule:
+        if len(pending) >= _CHUNK_WINDOW:
+            _land()
+        p = (
+            leaves[i]
+            if s is None
+            else jax.lax.slice_in_dim(leaves[i], s, e, axis=0)
+        )
+        if hasattr(p, "copy_to_host_async"):
+            p.copy_to_host_async()
+        pending.append((i, p))
+    while pending:
+        _land()
+
+    out = []
+    for i in range(len(leaves)):
+        parts = uploaded[i]
+        if len(parts) == 1:
+            out.append(parts[0])
+        else:
+            # concat runs on the target devices (HBM-speed); re-put pins
+            # the exact target sharding (concat's inferred may differ)
+            out.append(
+                jax.device_put(
+                    jax.numpy.concatenate(parts, axis=0), sh_leaves[i]
+                )
+            )
+    return out

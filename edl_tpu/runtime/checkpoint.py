@@ -43,6 +43,25 @@ def restore(
     return shard_state(host_state, plan, mesh, param_pspecs)
 
 
+def staged_reshard(
+    state: TrainState, plan: MeshPlan, mesh, param_pspecs=None
+) -> TrainState:
+    """Device → host → device as ONE overlapped pipeline — the host
+    fallback of the reshard protocol when ``snapshot`` + ``restore``
+    would run the two transfer directions back to back. Delegates to
+    :func:`edl_tpu.parallel.sharding.stream_reshard` (shared window and
+    piece policies with ``to_host``); the sum-form snapshot/restore
+    pair remains for disk checkpoints."""
+    from edl_tpu.train.trainer import state_pspecs
+
+    sharding_tree = shd.named(state_pspecs(state, plan, param_pspecs), mesh)
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    sh_leaves = treedef.flatten_up_to(sharding_tree)
+    return jax.tree_util.tree_unflatten(
+        treedef, shd.stream_reshard(leaves, sh_leaves)
+    )
+
+
 # -- disk format -------------------------------------------------------------
 
 

@@ -263,9 +263,9 @@ class ElasticTrainer:
                 # deterministic spec bugs will fail again here and surface
                 log.warn("device reshard failed; staging via host", error=str(e))
                 with tracing.span("reshard.host_staging"):
-                    host = ckpt.snapshot(old_state)
-                    self.state = ckpt.restore(
-                        host, self.plan, self.mesh, self._pspecs
+                    # overlapped down/up pipeline: ~max(d2h, h2d), not sum
+                    self.state = ckpt.staged_reshard(
+                        old_state, self.plan, self.mesh, self._pspecs
                     )
             del old_state
         ev = ReshardEvent(

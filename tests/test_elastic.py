@@ -119,3 +119,85 @@ def test_checkpoint_roundtrip(tmp_path, cpu_devices):
     loaded = ckpt.load(str(tmp_path / "c1"), like)
     jax.tree_util.tree_map(np.testing.assert_array_equal, host.params, loaded.params)
     assert ckpt.load_metadata(str(tmp_path / "c1")) == {"job": "demo"}
+
+
+def test_staged_reshard_preserves_state_across_mesh_change(cpu_devices):
+    """staged_reshard (overlapped host pipeline) must be value-identical
+    to snapshot+restore when moving state onto a different-size mesh."""
+    import numpy as np
+    import optax
+
+    from edl_tpu.models import ctr
+    from edl_tpu.parallel.mesh import MeshPlan
+    from edl_tpu.runtime import checkpoint as ckpt
+    from edl_tpu.train.trainer import TrainState, shard_state
+
+    import jax
+
+    from edl_tpu.parallel import sharding as shd
+
+    plan8 = MeshPlan.data_parallel(8)
+    mesh8 = plan8.build()
+    tx = optax.adam(1e-3)
+    chunk = shd._CHUNK_BYTES
+    try:
+        shd._CHUNK_BYTES = 1 << 12  # 4 KB: exercise multi-piece path
+        state = shard_state(
+            TrainState.create(
+                ctr.init_params(jax.random.PRNGKey(0), vocab=2048, emb=8), tx
+            ),
+            plan8,
+            mesh8,
+        )
+        plan4 = MeshPlan.data_parallel(4)
+        mesh4 = plan4.build(jax.devices()[:4])
+        out = ckpt.staged_reshard(state, plan4, mesh4)
+        ref = ckpt.restore(ckpt.snapshot(state), plan4, mesh4)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(out), jax.tree_util.tree_leaves(ref)
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert int(out.step) == int(state.step)
+    finally:
+        shd._CHUNK_BYTES = chunk
+
+
+def test_staged_reshard_onto_fsdp_mesh(cpu_devices):
+    """Regression: pieces uploaded to an fsdp-sharded destination must
+    split on the target's dim-0 partition count — ragged pieces make
+    device_put raise (vocab 2048 / 8-way fsdp; tiny piece size forces
+    many pieces whose raw ceil-rows would not divide by 8)."""
+    import numpy as np
+    import optax
+
+    from edl_tpu.models import ctr
+    from edl_tpu.parallel import sharding as shd
+    from edl_tpu.parallel.mesh import MeshPlan
+    from edl_tpu.runtime import checkpoint as ckpt
+    from edl_tpu.train.trainer import TrainState, shard_state
+
+    import jax
+
+    src_plan = MeshPlan.data_parallel(1)  # single-device: pieces split
+    src_mesh = src_plan.build(jax.devices()[:1])
+    fsdp_plan = MeshPlan.fsdp_only(8)
+    fsdp_mesh = fsdp_plan.build()
+    tx = optax.adam(1e-3)
+    chunk = shd._CHUNK_BYTES
+    try:
+        shd._CHUNK_BYTES = 3 << 10  # odd size: ceil-rows not % 8
+        state = shard_state(
+            TrainState.create(
+                ctr.init_params(jax.random.PRNGKey(0), vocab=2048, emb=8), tx
+            ),
+            src_plan,
+            src_mesh,
+        )
+        out = ckpt.staged_reshard(state, fsdp_plan, fsdp_mesh)
+        ref = ckpt.restore(ckpt.snapshot(state), fsdp_plan, fsdp_mesh)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(out), jax.tree_util.tree_leaves(ref)
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    finally:
+        shd._CHUNK_BYTES = chunk
