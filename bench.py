@@ -95,6 +95,50 @@ def main() -> None:
         best_dt = min(best_dt, time.perf_counter() - t0)
     eps_per_chip = BATCH * (MEASURE // CHUNK) * CHUNK / best_dt / n_dev
 
+    # flagship (Llama + pallas flash attention) train-step throughput:
+    # the d512/L4 graft-entry config, bf16, T=2048 causal
+    from edl_tpu.models import llama
+
+    lcfg = llama.LlamaConfig(
+        vocab=32768,
+        d_model=512,
+        n_layers=4,
+        n_heads=8,
+        n_kv_heads=4,
+        d_ff=1536,
+        dtype=jnp.bfloat16,
+        # interpret-mode pallas off-TPU would take hours; XLA attention
+        # keeps the bench smoke-runnable on a dev box
+        use_flash=jax.devices()[0].platform == "tpu",
+    )
+    lb, lt = 8 * n_dev, 2048  # 8 sequences per chip on any mesh size
+    lsteps = 2  # fused steps per dispatch
+    lreps = 4  # dispatches per timed loop
+    lstate = shard_state(
+        TrainState.create(llama.init_params(jax.random.PRNGKey(1), lcfg), tx),
+        plan,
+        mesh,
+    )
+    ltoks = stack_batches(
+        [llama.synthetic_tokens(rng, lb, lt, lcfg.vocab) for _ in range(lsteps)],
+        plan,
+        mesh,
+    )
+    lmulti = make_train_multistep(llama.make_loss_fn(lcfg), tx, plan, mesh)
+    lstate, lm = lmulti(lstate, ltoks)
+    float(lm["loss"])  # compile + warmup
+    ltok_rate = 0.0
+    for _ in range(2):
+        t3 = time.perf_counter()
+        for _ in range(lreps):
+            lstate, lm = lmulti(lstate, ltoks)
+        float(lm["loss"])
+        ltok_rate = max(
+            ltok_rate,
+            lreps * lsteps * lb * lt / (time.perf_counter() - t3) / n_dev,
+        )
+    del lstate, ltoks
+
     # reshard stall, both protocol paths on this chip, min of 2 runs
     # (host<->device bandwidth on a tunneled chip is noisy; min is the
     # standard interference-suppressing estimator):
@@ -127,6 +171,7 @@ def main() -> None:
                 "vs_baseline": 1.0,
                 "reshard_stall_s": round(stall_fast_s, 4),
                 "reshard_stall_host_fallback_s": round(stall_host_s, 4),
+                "llama_tokens_per_sec_per_chip": round(ltok_rate, 1),
                 "compile_s": round(compile_s, 2),
                 "final_loss": round(float(m["loss"]), 4),
                 "n_devices": n_dev,
