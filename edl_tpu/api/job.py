@@ -175,6 +175,16 @@ class TrainingJobStatus:
     last_reshard_stall_s: float = 0.0
 
 
+def qualify(namespace: str, name: str) -> str:
+    """Qualified job identity from (namespace, name) — the one rule
+    behind ``TrainingJob.qualified_name``, shared by cluster backends
+    that must address updaters without holding a TrainingJob (e.g.
+    scale-listener notifications)."""
+    if namespace in ("", "default"):
+        return name
+    return f"{namespace}/{name}"
+
+
 @dataclass
 class TrainingJob:
     """The job object: metadata + spec + status
@@ -193,9 +203,7 @@ class TrainingJob:
         readable), ``namespace/name`` elsewhere — same-named jobs in
         different namespaces must not share controller/autoscaler
         state."""
-        if self.namespace in ("", "default"):
-            return self.name
-        return f"{self.namespace}/{self.name}"
+        return qualify(self.namespace, self.name)
 
     # -- predicates (reference: pkg/resource/training_job.go:189-207) ------
 

@@ -41,6 +41,19 @@ class WorkerGroup:
     failed: int = 0
 
 
+def group_job_name(group: WorkerGroup) -> str:
+    """Bare name of the job a worker group belongs to — the single
+    derivation rule shared by every backend's scale-listener path:
+    the ``edl-job`` label when present (set by JobParser), else the
+    ``<job>-worker`` naming convention."""
+    labeled = group.plan.labels.get("edl-job") if group.plan else None
+    if labeled:
+        return labeled
+    if group.name.endswith("-worker"):
+        return group.name[: -len("-worker")]
+    return group.name
+
+
 @dataclass
 class Coordinator:
     """Handle on a job's coordinator (master ReplicaSet analog)."""

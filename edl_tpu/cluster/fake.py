@@ -16,7 +16,7 @@ import threading
 from dataclasses import dataclass, field, replace
 from typing import Callable, Dict, List, Optional, Tuple
 
-from edl_tpu.api.job import Event, TrainingJob
+from edl_tpu.api.job import Event, TrainingJob, qualify
 from edl_tpu.api.parser import CoordinatorPlan, WorkerGroupPlan
 from edl_tpu.cluster.base import (
     Cluster,
@@ -24,6 +24,7 @@ from edl_tpu.cluster.base import (
     Coordinator,
     PodPhase,
     WorkerGroup,
+    group_job_name,
 )
 from edl_tpu.cluster.resource import ClusterResource, Hosts
 from edl_tpu.utils.logging import kv_logger
@@ -198,7 +199,12 @@ class FakeCluster(Cluster):
                     f"stale resource_version {group.resource_version} != {cur.resource_version}"
                 )
             if group.parallelism != cur.parallelism:
-                fire = (cur.plan.labels.get("edl-job", cur.name), group.parallelism)
+                # qualified name: scale listeners address updaters keyed
+                # by it (bare names alias across namespaces)
+                fire = (
+                    qualify(group.namespace, group_job_name(cur)),
+                    group.parallelism,
+                )
             cur.parallelism = group.parallelism
             cur.resource_version += 1
             listeners = list(self.scale_listeners)

@@ -35,7 +35,7 @@ import urllib.parse
 import urllib.request
 from typing import Callable, Dict, List, Optional, Tuple
 
-from edl_tpu.api.job import TrainingJob
+from edl_tpu.api.job import TrainingJob, qualify
 from edl_tpu.api.parser import CoordinatorPlan, WorkerGroupPlan
 from edl_tpu.api.resources import chip_count, cpu_milli, mem_mega
 from edl_tpu.cluster.base import (
@@ -43,6 +43,7 @@ from edl_tpu.cluster.base import (
     ConflictError,
     Coordinator,
     WorkerGroup,
+    group_job_name,
 )
 from edl_tpu.cluster.resource import ClusterResource, Hosts
 from edl_tpu.utils.logging import kv_logger
@@ -385,13 +386,13 @@ class KubeCluster(Cluster):
             if e.status == 409:
                 raise ConflictError(str(e)) from e
             raise
-        job_name = (
-            group.name[: -len("-worker")]
-            if group.name.endswith("-worker")
-            else group.name
-        )
+        # scale listeners address updaters, which are keyed by the
+        # qualified name — a bare name would silently miss jobs outside
+        # the default namespace (and alias same-named jobs across
+        # namespaces)
+        qualified = qualify(group.namespace, group_job_name(group))
         for listener in list(self.scale_listeners):
-            listener(job_name, group.parallelism)
+            listener(qualified, group.parallelism)
 
     def delete_worker_group(self, namespace: str, name: str) -> None:
         try:

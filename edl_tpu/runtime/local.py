@@ -79,7 +79,7 @@ class LocalJobRunner:
             u.runtime_attached = False
 
     def _on_scale(self, job_name: str, parallelism: int) -> None:
-        if job_name == self.job.name:
+        if job_name == self.job.qualified_name:
             self.trainer.request_rescale(parallelism)
 
     def _reshard_done(self, ev: ReshardEvent) -> None:

@@ -64,6 +64,8 @@ def main() -> int:
     print(f"submitted {job.name}: fixed {n_workers} workers")
     if args.epochs is None:
         args.epochs = job.spec.passes  # manifest is the single source
+    if args.epochs < 1:
+        ap.error(f"--epochs/spec.passes must be >= 1, got {args.epochs}")
     # every worker must own at least one chunk: shrink chunks if the
     # dataset is small rather than dividing by an empty shard
     args.chunk = min(args.chunk, max(args.samples // n_workers, 1))

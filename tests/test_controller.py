@@ -145,6 +145,25 @@ def test_controller_end_to_end_sync():
     assert ("default", "j1-worker") not in c.groups
 
 
+def test_scale_event_reaches_non_default_namespace_updater():
+    """Scale listeners must fire with the qualified name: updaters are
+    keyed by it, so a bare-name notification would silently miss any
+    job outside the default namespace (and alias same-named jobs
+    across namespaces)."""
+    c = tpu_fleet()
+    ctl = Controller(c, max_load_desired=1.0)
+    job = make_job()
+    job.namespace = "team-a"
+    c.submit_job(job)
+    ctl.step()
+    assert ctl.phase_of("team-a/j1") == JobPhase.RUNNING
+    ctl.autoscaler.tick()
+    assert c.get_worker_group(job).parallelism == 4
+    # the SCALING phase must surface on THIS job's updater
+    assert ctl.phase_of("team-a/j1") == JobPhase.SCALING
+    assert job.status.reshard_count == 1
+
+
 def test_controller_threaded_run():
     c = tpu_fleet()
     ctl = Controller(c, max_load_desired=1.0)
