@@ -102,6 +102,10 @@ class MeshSpec:
     pp: int = 0  # pipeline parallel
     sp: int = 0  # sequence/context parallel (ring attention)
     ep: int = 0  # expert parallel (MoE)
+    # which axis absorbs elastic membership change (the others keep
+    # their pinned sizes across rescales); "dp" for pure replication
+    # growth, "fsdp" for the flagship ZeRO-3-growth config
+    growth: str = "dp"
 
     def axis_sizes(self) -> Dict[str, int]:
         return {
@@ -116,6 +120,14 @@ class MeshSpec:
             )
             if v > 1
         }
+
+    def to_mesh_string(self) -> str:
+        """The EDL_MESH env value (MeshPlan.parse grammar): pinned axes
+        as ``axis=K`` terms plus the bare growth axis."""
+        terms = [
+            f"{k}={v}" for k, v in self.axis_sizes().items() if k != self.growth
+        ]
+        return ",".join([self.growth] + terms)
 
 
 @dataclass
@@ -148,6 +160,12 @@ class TrainingJobSpec:
     accelerator_type: str = ""
     node_selector: Dict[str, str] = field(default_factory=dict)
     mesh: MeshSpec = field(default_factory=MeshSpec)
+    # shared checkpoint store (a mounted volume path in pods) and the
+    # periodic sharded-commit cadence in steps. Required for fsdp-growth
+    # jobs: a crashed peer's primary shards only survive in the last
+    # committed checkpoint. 0 = commit only at reshard/stop.
+    checkpoint_dir: str = ""
+    checkpoint_every: int = 0
     master: MasterSpec = field(default_factory=MasterSpec)
     pserver: PserverSpec = field(default_factory=PserverSpec)
     worker: WorkerSpec = field(default_factory=WorkerSpec)
@@ -245,9 +263,26 @@ class TrainingJob:
                 f"unknown mesh axes {sorted(bad_axes)}; valid: {sorted(mesh_fields)}"
             )
         try:
-            mesh = MeshSpec(**{k: int(v) for k, v in mesh_d.items()})
+            growth = str(mesh_d.get("growth", "dp"))
+            mesh = MeshSpec(
+                growth=growth,
+                **{k: int(v) for k, v in mesh_d.items() if k != "growth"},
+            )
         except (TypeError, ValueError) as e:
             raise ValueError(f"invalid mesh spec {mesh_d!r}: {e}") from e
+        if mesh.growth not in ("dp", "fsdp"):
+            # only batch axes can absorb elastic membership change (see
+            # MeshPlan.parse); tp/pp/sp/ep growth would silently change
+            # per-process batch rows under a fixed queue chunk
+            raise ValueError(
+                f"mesh growth axis must be dp or fsdp, got {mesh.growth!r}"
+            )
+        if mesh.axis_sizes().get(mesh.growth):
+            raise ValueError(
+                f"mesh axis {mesh.growth!r} is the growth axis; its size is "
+                "set by the elastic worker count, not the manifest — remove "
+                f"the pinned size or change 'growth'"
+            )
         spec = TrainingJobSpec(
             image=spec_d.get("image", ""),
             host_network=bool(spec_d.get("host_network", False)),
@@ -258,6 +293,8 @@ class TrainingJob:
             accelerator_type=spec_d.get("accelerator_type", ""),
             node_selector=dict(spec_d.get("node_selector", {})),
             mesh=mesh,
+            checkpoint_dir=spec_d.get("checkpoint_dir", ""),
+            checkpoint_every=int(spec_d.get("checkpoint_every", 0)),
             master=MasterSpec(
                 coordinator_endpoint=master_d.get(
                     "coordinator_endpoint", master_d.get("etcd-endpoint", "")
@@ -316,8 +353,14 @@ class TrainingJob:
         if s.node_selector:
             spec["node_selector"] = dict(s.node_selector)
         mesh = {k: v for k, v in s.mesh.axis_sizes().items()}
+        if s.mesh.growth != "dp":
+            mesh["growth"] = s.mesh.growth
         if mesh:
             spec["mesh"] = mesh
+        if s.checkpoint_dir:
+            spec["checkpoint_dir"] = s.checkpoint_dir
+        if s.checkpoint_every:
+            spec["checkpoint_every"] = s.checkpoint_every
         master: dict = {}
         if s.master.coordinator_endpoint:
             master["coordinator_endpoint"] = s.master.coordinator_endpoint

@@ -126,6 +126,12 @@ class JobParser:
             warnings.append(
                 f"mesh plan ({mesh_total} devices) does not tile chips/worker ({chips})"
             )
+        if job.elastic() and s.mesh.growth == "fsdp" and not s.checkpoint_dir:
+            raise ValidationError(
+                "elastic fsdp-growth jobs require spec.checkpoint_dir: state "
+                "is sharded across workers, so rescale/recovery needs a "
+                "shared checkpoint store"
+            )
         return warnings
 
     # -- plan builders -----------------------------------------------------
@@ -185,6 +191,9 @@ class JobParser:
             "EDL_ACCELERATOR": s.accelerator_type,
             "EDL_NUM_PASSES": str(s.passes),
             "EDL_FAULT_TOLERANT": "1" if s.fault_tolerant else "0",
+            "EDL_MESH": s.mesh.to_mesh_string(),
+            "EDL_CKPT_DIR": s.checkpoint_dir,
+            "EDL_CKPT_EVERY": str(s.checkpoint_every),
             "EDL_COORDINATOR": s.master.coordinator_endpoint
             or f"{job.name}-coordinator:{s.port}",
         }

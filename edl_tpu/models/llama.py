@@ -99,24 +99,45 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> Dict:
 def param_pspecs(cfg: LlamaConfig, plan: MeshPlan) -> Dict:
     """2D TP×FSDP layout: tp on head/ffn width, fsdp on the other large
     dim; vocab-dim tp for embed/lm_head. Falls back gracefully when an
-    axis is absent (size 1 axes are legal in PartitionSpec)."""
+    axis is absent, and drops an axis from any dimension it does not
+    divide (elastic worlds are not always powers of two — a 6-way fsdp
+    mesh must still compile; the undivisible param is replicated on
+    that axis instead, exactly what the generic rule in
+    parallel/sharding.py does)."""
     tp = "tp" if plan.axis_size("tp") > 1 else None
     fs = "fsdp" if plan.axis_size("fsdp") > 1 else None
+    d, h, kv, hd, ff, L, V = (
+        cfg.d_model,
+        cfg.n_heads,
+        cfg.n_kv_heads,
+        cfg.head_dim,
+        cfg.d_ff,
+        cfg.n_layers,
+        cfg.vocab,
+    )
+
+    def fit(shape, *axes):
+        parts = []
+        for dim, ax in zip(shape, axes):
+            ok = ax is not None and dim % plan.axis_size(ax) == 0
+            parts.append(ax if ok else None)
+        return P(*parts)
+
     return {
-        "embed": P(tp, fs),  # [vocab, d]
+        "embed": fit((V, d), tp, fs),
         "layers": {
             "ln1": P(None, None),
-            "wq": P(None, fs, tp),  # [L, d, H*hd]
-            "wk": P(None, fs, tp),
-            "wv": P(None, fs, tp),
-            "wo": P(None, tp, fs),  # [L, H*hd, d]
+            "wq": fit((L, d, h * hd), None, fs, tp),
+            "wk": fit((L, d, kv * hd), None, fs, tp),
+            "wv": fit((L, d, kv * hd), None, fs, tp),
+            "wo": fit((L, h * hd, d), None, tp, fs),
             "ln2": P(None, None),
-            "w1": P(None, fs, tp),  # [L, d, ff]
-            "w3": P(None, fs, tp),
-            "w2": P(None, tp, fs),  # [L, ff, d]
+            "w1": fit((L, d, ff), None, fs, tp),
+            "w3": fit((L, d, ff), None, fs, tp),
+            "w2": fit((L, ff, d), None, tp, fs),
         },
         "ln_f": P(None),
-        "lm_head": P(fs, tp),  # [d, vocab]
+        "lm_head": fit((d, V), fs, tp),
     }
 
 

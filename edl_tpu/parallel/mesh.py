@@ -65,6 +65,65 @@ class MeshPlan:
         return cls.create(**sizes)
 
     @classmethod
+    def parse(cls, mesh: str, n_devices: int) -> "MeshPlan":
+        """Parse an elastic mesh string against a device count.
+
+        Grammar: comma-separated axis terms. ``axis=K`` pins a fixed
+        size; a bare ``axis`` name declares the GROWTH axis that
+        absorbs whatever device count the elastic membership currently
+        provides (default ``dp``). Examples::
+
+            "dp"             all devices data-parallel
+            "fsdp"           all devices ZeRO-3 (the flagship config)
+            "fsdp,tp=2"      tp pinned at 2, fsdp grows with the job
+            "fsdp=2,tp=2"    both pinned; remainder grows on dp
+
+        This is the EDL_MESH env contract consumed by the worker
+        runtime (the TPU analog of the reference's fixed
+        --trainer_count, docker/paddle_k8s:206 — here the axis layout
+        survives elastic rescale because one axis is declared free).
+        """
+        s = (mesh or "dp").strip()
+        fixed: Dict[str, int] = {}
+        grow = "dp"
+        for part in s.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "=" in part:
+                k, v = part.split("=", 1)
+                size = int(v)
+                if size < 1:
+                    raise ValueError(
+                        f"mesh axis size must be >= 1: {part!r} in {s!r}"
+                    )
+                fixed[k.strip()] = size
+            else:
+                grow = part
+        unknown = (set(fixed) | {grow}) - set(AXIS_ORDER)
+        if unknown:
+            raise ValueError(f"unknown mesh axes {sorted(unknown)} in {s!r}")
+        if grow in fixed:
+            raise ValueError(f"axis {grow!r} is both fixed and the growth axis")
+        if grow not in BATCH_AXES:
+            # a non-batch growth axis would change _local_rows without
+            # changing the queue chunk, silently truncating every leased
+            # task after a rescale — only batch axes may absorb
+            # membership change
+            raise ValueError(
+                f"growth axis must be one of {BATCH_AXES}, got {grow!r}"
+            )
+        prod = math.prod(fixed.values()) if fixed else 1
+        if n_devices % prod:
+            raise ValueError(
+                f"fixed mesh axes {fixed} (={prod}) do not divide "
+                f"{n_devices} devices"
+            )
+        sizes = dict(fixed)
+        sizes[grow] = n_devices // prod
+        return cls.create(**sizes)
+
+    @classmethod
     def data_parallel(cls, n_devices: int) -> "MeshPlan":
         return cls.create(dp=n_devices)
 

@@ -6,9 +6,21 @@ The reference delegates persistence to workload code
 checkpointing is first-class (SURVEY §5: "it is the reshard mechanism"):
 
 - ``snapshot``/``restore``: device state ⇄ host RAM — the fast path an
-  elastic rescale rides (no disk in the loop).
+  elastic rescale rides (no disk in the loop). Valid only when every
+  array is fully addressable from this process (single-process meshes,
+  or dp-replicated state).
 - ``save``/``load``: host snapshot ⇄ disk, flattened-keypath npz — the
-  crash-recovery path.
+  single-file crash-recovery path for small states.
+- ``snapshot_local``/``save_shards``/``write_manifest``/``load_sharded``:
+  the multi-process sharded format. Each process snapshots ONLY its
+  addressable shards (host RAM bounded by local shard bytes), writes
+  one ``shards-r<rank>-of-<world>.npz``, a leader commits
+  ``manifest.json`` last (manifest presence = checkpoint valid), and a
+  later epoch at ANY world size restores by assembling exactly the
+  pieces its local devices need — RAM pieces when the step matches,
+  disk pieces otherwise. This replaces the reference's trainer-0
+  full-state save (example/ctr/ctr/train.py:169-180), which cannot
+  scale to FSDP state that no single host can materialize.
 """
 
 from __future__ import annotations
@@ -16,7 +28,8 @@ from __future__ import annotations
 import json
 import os
 import tempfile
-from typing import Any, Dict, Tuple
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -135,3 +148,429 @@ def load_metadata(path: str) -> Dict[str, Any]:
         with open(sidecar) as f:
             return json.load(f)
     return {}
+
+
+# ---------------------------------------------------------------------------
+# sharded multi-process format
+#
+# Layout under a checkpoint root:
+#   <root>/step-00000042/shards-r0003-of-0004.npz   (one per writing rank)
+#   <root>/step-00000042/manifest.json              (committed LAST, atomic)
+#
+# npz entry key: "<p|o>:<leaf path>@<comma-joined offsets>" — the piece's
+# position in the global array. The manifest carries global shapes/dtypes,
+# the step, metadata, and the exact file list; a loader trusts only
+# manifest-listed files (stale/partial writer files are ignored).
+
+
+@dataclass
+class LocalSnapshot:
+    """One process's addressable fraction of a TrainState, on host.
+
+    ``pieces[key]`` maps a flattened leaf key to ``[(offset, array)]`` —
+    every distinct shard this process holds (deduped across local
+    replica devices). ``primary[key]`` lists the offsets for which this
+    process owns replica 0 — the disk-write set: across all processes
+    the primary pieces tile every global array exactly once.
+    """
+
+    step: int
+    pieces: Dict[str, List[Tuple[Tuple[int, ...], np.ndarray]]]
+    primary: Dict[str, List[Tuple[int, ...]]]
+    shapes: Dict[str, Tuple[int, ...]]
+    dtypes: Dict[str, str]
+    # leaves that were plain host arrays (no replica ownership): every
+    # process claims them, so only the writer leader puts them on disk
+    host_only: Dict[str, bool] = field(default_factory=dict)
+
+    def is_complete(self) -> bool:
+        """True when this process alone holds every byte of the state
+        (dp-replicated or single-process meshes) — the condition for a
+        solo crash-checkpoint write."""
+        for key, shape in self.shapes.items():
+            total = int(np.prod(shape)) if shape else 1
+            have = sum(
+                int(np.prod(p.shape)) if p.shape else 1
+                for _, p in self.pieces.get(key, [])
+            )
+            if have < total:
+                return False
+        return True
+
+
+def _state_leaf_items(state: TrainState):
+    """Flattened (key, leaf) pairs with the p:/o: prefixes shared with
+    the single-file format."""
+    items = [(f"p:{k}", v) for k, v in _leaf_keys(state.params)]
+    items += [(f"o:{k}", v) for k, v in _leaf_keys(state.opt_state)]
+    return items
+
+
+def snapshot_local(state: TrainState) -> LocalSnapshot:
+    """Device → host for THIS process's addressable shards only.
+
+    Works on any multi-process sharded state (where ``snapshot``'s
+    whole-tree ``jax.device_get`` would fail on non-addressable
+    arrays); host RAM is bounded by the process-local shard bytes.
+    Transfers are issued async first, then landed.
+    """
+    items = _state_leaf_items(state)
+    # issue all D2H copies before blocking on any
+    for _, leaf in items:
+        if isinstance(leaf, jax.Array):
+            for sh in leaf.addressable_shards:
+                try:
+                    sh.data.copy_to_host_async()
+                except Exception:  # pragma: no cover - backend-dependent
+                    pass
+    pieces: Dict[str, List[Tuple[Tuple[int, ...], np.ndarray]]] = {}
+    primary: Dict[str, List[Tuple[int, ...]]] = {}
+    shapes: Dict[str, Tuple[int, ...]] = {}
+    dtypes: Dict[str, str] = {}
+    host_only: Dict[str, bool] = {}
+    for key, leaf in items:
+        shapes[key] = tuple(getattr(leaf, "shape", ()))
+        dtypes[key] = np.dtype(getattr(leaf, "dtype", np.float32)).name
+        if isinstance(leaf, jax.Array):
+            by_off: Dict[Tuple[int, ...], np.ndarray] = {}
+            prim: set = set()
+            for sh in leaf.addressable_shards:
+                off = tuple(int(s.start or 0) for s in sh.index)
+                if off not in by_off:
+                    by_off[off] = np.asarray(sh.data)
+                if sh.replica_id == 0:
+                    prim.add(off)
+            pieces[key] = sorted(by_off.items())
+            primary[key] = sorted(prim)
+        else:  # host leaf: whole array, claimed by every process
+            arr = np.asarray(leaf)
+            off = tuple(0 for _ in arr.shape)
+            pieces[key] = [(off, arr)]
+            primary[key] = [off]
+            host_only[key] = True
+    return LocalSnapshot(
+        step=int(jax.device_get(state.step)),
+        pieces=pieces,
+        primary=primary,
+        shapes=shapes,
+        dtypes=dtypes,
+        host_only=host_only,
+    )
+
+
+def _piece_key(key: str, off: Tuple[int, ...], shape: Tuple[int, ...]) -> str:
+    """Entry name carries position AND extent so a loader can test
+    overlap against a target slice without touching the bytes."""
+    return (
+        f"{key}@{','.join(map(str, off))}@{','.join(map(str, shape))}"
+    )
+
+
+def _parse_piece_key(k: str) -> Tuple[str, Tuple[int, ...], Tuple[int, ...]]:
+    key, _, shape_s = k.rpartition("@")
+    key, _, off_s = key.rpartition("@")
+    off = tuple(int(x) for x in off_s.split(",")) if off_s else ()
+    shape = tuple(int(x) for x in shape_s.split(",")) if shape_s else ()
+    return key, off, shape
+
+
+def step_dir(root: str, step: int) -> str:
+    return os.path.join(root, f"step-{step:08d}")
+
+
+def shard_filename(rank: int, world: int) -> str:
+    return f"shards-r{rank:04d}-of-{world:04d}.npz"
+
+
+def save_shards(
+    root: str,
+    snap: LocalSnapshot,
+    rank: int,
+    world: int,
+    host_leaves: bool = False,
+    all_pieces: bool = False,
+) -> str:
+    """Write this process's primary pieces into the step directory
+    (atomic tmp+rename). Replica-0 ownership already makes jax-array
+    pieces unique across processes — including fully-replicated leaves,
+    whose replica 0 lives on exactly one process. Host (numpy) leaves
+    have no replica notion, so every snapshot claims them; only the
+    rank passed ``host_leaves=True`` (the writer leader) includes them.
+    ``all_pieces=True`` writes every local piece regardless of replica
+    ownership — the solo crash-write path, where a surviving process
+    with a complete (dp-replicated) snapshot must persist leaves whose
+    replica 0 lived on the dead peer. Returns the shard filename (for
+    the leader's manifest)."""
+    d = step_dir(root, snap.step)
+    os.makedirs(d, exist_ok=True)
+    payload: Dict[str, np.ndarray] = {}
+    for key, plist in snap.pieces.items():
+        if all_pieces:
+            chosen = plist
+        else:
+            if snap.host_only.get(key) and not host_leaves:
+                continue
+            prim = set(snap.primary.get(key, ()))
+            chosen = [(o, a) for o, a in plist if o in prim]
+        for off, arr in chosen:
+            payload[_piece_key(key, off, tuple(arr.shape))] = arr
+    fname = shard_filename(rank, world)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz.tmp")
+    os.close(fd)
+    with open(tmp, "wb") as f:
+        np.savez(f, **payload)
+    os.replace(tmp, os.path.join(d, fname))
+    return fname
+
+
+def write_manifest(
+    root: str,
+    snap: LocalSnapshot,
+    files: List[str],
+    metadata: Optional[Dict[str, Any]] = None,
+) -> None:
+    """Commit the checkpoint: manifest.json names the step, the leaf
+    schema, and the exact shard files. Written atomically, LAST — a
+    step dir without a manifest is an aborted write and is ignored by
+    loaders and reaped by :func:`gc_step_dirs`."""
+    d = step_dir(root, snap.step)
+    doc = {
+        "step": snap.step,
+        "files": sorted(set(files)),
+        "shapes": {k: list(v) for k, v in snap.shapes.items()},
+        "dtypes": snap.dtypes,
+        "meta": metadata or {},
+    }
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".json.tmp")
+    os.close(fd)
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, os.path.join(d, "manifest.json"))
+
+
+def latest_manifest(root: str) -> Optional[Dict[str, Any]]:
+    """Newest committed checkpoint's manifest (with its directory under
+    key ``_dir``), or None."""
+    if not os.path.isdir(root):
+        return None
+    best = None
+    for name in sorted(os.listdir(root), reverse=True):
+        if not name.startswith("step-"):
+            continue
+        mpath = os.path.join(root, name, "manifest.json")
+        if os.path.exists(mpath):
+            with open(mpath) as f:
+                best = json.load(f)
+            best["_dir"] = os.path.join(root, name)
+            break
+    return best
+
+
+def gc_step_dirs(root: str, keep: int = 2) -> None:
+    """Drop all but the newest ``keep`` committed checkpoints, plus any
+    aborted (manifest-less) dirs older than the newest committed one."""
+    import shutil
+
+    if not os.path.isdir(root):
+        return
+    dirs = sorted(d for d in os.listdir(root) if d.startswith("step-"))
+    committed = [
+        d for d in dirs if os.path.exists(os.path.join(root, d, "manifest.json"))
+    ]
+    victims = set(committed[:-keep] if keep else committed)
+    if committed:
+        newest = committed[-1]
+        victims |= {
+            d
+            for d in dirs
+            if d < newest and d not in committed
+        }
+    for d in victims:
+        shutil.rmtree(os.path.join(root, d), ignore_errors=True)
+
+
+class _PieceIndex:
+    """Piece lookup across RAM snapshot + manifest-listed shard files.
+    Entry keys carry (offset, shape), so overlap against a target slice
+    is decided without I/O; disk pieces load lazily (npz members
+    decompress on access) — a process reads only the bytes its local
+    devices need."""
+
+    def __init__(
+        self,
+        manifest: Optional[Dict[str, Any]],
+        ram: Optional[LocalSnapshot],
+    ):
+        # {leaf key: {offset: (shape, source)}} where source is either a
+        # host array or an (NpzFile, entry) lazy handle; RAM wins over
+        # disk at equal offsets (same bytes, no I/O)
+        self._index: Dict[str, Dict[Tuple[int, ...], Tuple[Tuple[int, ...], Any]]] = {}
+        self._files: List[Any] = []
+        if manifest is not None:
+            for fname in manifest["files"]:
+                z = np.load(
+                    os.path.join(manifest["_dir"], fname), allow_pickle=False
+                )
+                self._files.append(z)
+                for entry in z.files:
+                    key, off, shape = _parse_piece_key(entry)
+                    self._index.setdefault(key, {})[off] = (shape, (z, entry))
+        if ram is not None:
+            for key, plist in ram.pieces.items():
+                for off, arr in plist:
+                    self._index.setdefault(key, {})[off] = (
+                        tuple(arr.shape),
+                        arr,
+                    )
+
+    def close(self) -> None:
+        for z in self._files:
+            z.close()
+
+    def assemble(
+        self, key: str, idx: Tuple, shape: Tuple[int, ...], dtype
+    ) -> np.ndarray:
+        """Materialize the slice ``idx`` of leaf ``key`` from stored
+        pieces. Pieces share one disjoint tiling (all were cut by the
+        writing epoch's sharding), so clipped volumes summing to the
+        target volume proves full coverage."""
+        starts = [
+            (s.start or 0) if isinstance(s, slice) else 0 for s in idx
+        ]
+        stops = [
+            (s.stop if s.stop is not None else shape[i])
+            if isinstance(s, slice)
+            else shape[i]
+            for i, s in enumerate(idx)
+        ]
+        out_shape = tuple(e - b for b, e in zip(starts, stops))
+        out = np.empty(out_shape, dtype)
+        covered = 0
+        for off, (pshape, src) in self._index.get(key, {}).items():
+            if not shape:  # scalar leaf
+                out[...] = src if isinstance(src, np.ndarray) else src[0][src[1]]
+                covered = 1
+                break
+            lo = [max(b, o) for b, o in zip(starts, off)]
+            hi = [min(e, o + s) for e, o, s in zip(stops, off, pshape)]
+            if any(l >= h for l, h in zip(lo, hi)):
+                continue  # no overlap: piece bytes never touched
+            arr = src if isinstance(src, np.ndarray) else src[0][src[1]]
+            out[
+                tuple(slice(l - b, h - b) for l, b, h in zip(lo, starts, hi))
+            ] = arr[
+                tuple(slice(l - o, h - o) for l, o, h in zip(lo, off, hi))
+            ]
+            covered += int(np.prod([h - l for l, h in zip(lo, hi)]))
+        total = int(np.prod(out_shape)) if out_shape else 1
+        if covered < total:
+            raise ValueError(
+                f"checkpoint piece coverage incomplete for {key}{idx}: "
+                f"{covered}/{total} elements"
+            )
+        return out
+
+
+def _materialize(
+    index: _PieceIndex,
+    step: int,
+    like: TrainState,
+    state_shardings: TrainState,
+    shapes: Dict[str, Tuple[int, ...]],
+    dtypes: Dict[str, str],
+) -> TrainState:
+    def _build(prefix: str, tmpl, shardings):
+        keys = [k for k, _ in _leaf_keys(tmpl)]
+        leaves = jax.tree_util.tree_leaves(tmpl)
+        sh_leaves = jax.tree_util.tree_leaves(
+            shardings, is_leaf=lambda x: hasattr(x, "device_set")
+        )
+        out = []
+        for key, leaf, sh in zip(keys, leaves, sh_leaves):
+            fq = f"{prefix}:{key}"
+            if fq not in shapes:
+                raise KeyError(f"checkpoint missing leaf {fq}")
+            shape = tuple(shapes[fq])
+            want = tuple(getattr(leaf, "shape", ()))
+            if shape != want:
+                raise ValueError(
+                    f"checkpoint shape mismatch at {fq}: {shape} vs {want}"
+                )
+            dt = np.dtype(dtypes[fq])
+            out.append(
+                jax.make_array_from_callback(
+                    shape,
+                    sh,
+                    lambda i, k=fq, s=shape, d=dt: index.assemble(k, i, s, d),
+                )
+            )
+        return jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(tmpl), out
+        )
+
+    step_sh = jax.tree_util.tree_leaves(
+        state_shardings.step, is_leaf=lambda x: hasattr(x, "device_set")
+    )[0]
+    step_val = np.asarray(step, np.int32)
+    return TrainState(
+        step=jax.make_array_from_callback((), step_sh, lambda i: step_val),
+        params=_build("p", like.params, state_shardings.params),
+        opt_state=_build("o", like.opt_state, state_shardings.opt_state),
+    )
+
+
+def load_sharded(
+    root: str,
+    like: TrainState,
+    state_shardings: TrainState,
+    ram: Optional[LocalSnapshot] = None,
+    manifest: Optional[Dict[str, Any]] = None,
+) -> TrainState:
+    """Assemble a TrainState onto a (possibly different-world) mesh from
+    the newest committed sharded checkpoint, preferring RAM pieces when
+    ``ram`` matches the checkpoint step. Each process materializes only
+    its local shards (``jax.make_array_from_callback``), so host RAM
+    stays bounded by local shard bytes at every world size.
+
+    ``like`` is a structure template (ShapeDtypeStructs are fine);
+    ``state_shardings`` a TrainState of NamedShardings for the target
+    mesh. Pass ``manifest`` (from :func:`latest_manifest`) to pin the
+    exact checkpoint — otherwise the newest committed one is re-scanned
+    here, which can race a concurrent commit.
+    """
+    if manifest is None:
+        manifest = latest_manifest(root)
+    if manifest is None:
+        raise FileNotFoundError(f"no committed sharded checkpoint under {root}")
+    if ram is not None and ram.step != manifest["step"]:
+        ram = None  # stale/ahead RAM: disk manifest is the agreed truth
+    index = _PieceIndex(manifest, ram)
+    try:
+        return _materialize(
+            index,
+            manifest["step"],
+            like,
+            state_shardings,
+            {k: tuple(v) for k, v in manifest["shapes"].items()},
+            manifest["dtypes"],
+        )
+    finally:
+        index.close()
+
+
+def restore_local(
+    like: TrainState,
+    state_shardings: TrainState,
+    ram: LocalSnapshot,
+) -> TrainState:
+    """RAM-only restore for states this process holds completely (dp
+    meshes / single process) when no checkpoint dir is configured — the
+    in-RAM reshard fast path without any disk in the loop."""
+    if not ram.is_complete():
+        raise ValueError(
+            "RAM snapshot does not cover the full state; a shared "
+            "checkpoint dir is required to reshard this mesh"
+        )
+    return _materialize(
+        _PieceIndex(None, ram), ram.step, like, state_shardings, ram.shapes, ram.dtypes
+    )

@@ -41,6 +41,7 @@ class WorkerProc:
 class ProcessJobLauncher:
     job: str = "job"
     model: str = "linreg"
+    mesh: str = "dp"  # MeshPlan.parse grammar: "dp" | "fsdp" | "fsdp,tp=2" …
     min_workers: int = 1
     max_workers: int = 8
     n_samples: int = 2048
@@ -51,7 +52,9 @@ class ProcessJobLauncher:
     member_ttl_s: float = 3.0
     lease_timeout_s: float = 4.0
     fault_tolerant: bool = True
+    ckpt_every: int = 0  # periodic sharded-commit cadence (steps)
     seed: int = 0
+    seq_len: int = 32  # llama workload sequence length
     step_sleep_s: float = 0.0
     extra_env: Dict[str, str] = field(default_factory=dict)
 
@@ -85,6 +88,9 @@ class ProcessJobLauncher:
                 "EDL_WORKERS_MAX": str(self.max_workers),
                 "EDL_FAULT_TOLERANT": "1" if self.fault_tolerant else "0",
                 "EDL_MODEL": self.model,
+                "EDL_MESH": self.mesh,
+                "EDL_CKPT_EVERY": str(self.ckpt_every),
+                "EDL_SEQ_LEN": str(self.seq_len),
                 "EDL_LOCAL_DEVICES": str(self.local_devices),
                 "EDL_PER_DEVICE_BATCH": str(self.per_device_batch),
                 "EDL_NUM_SAMPLES": str(self.n_samples),

@@ -31,6 +31,46 @@ def test_mesh_from_spec_completes_dp(cpu_devices):
         MeshPlan.from_spec(MeshSpec(tp=3), 8)
 
 
+def test_mesh_parse_grammar():
+    """EDL_MESH strings: bare axis = growth (absorbs the elastic device
+    count), axis=K pins, remainder defaults to dp."""
+    assert MeshPlan.parse("dp", 8).describe() == {"dp": 8}
+    assert MeshPlan.parse("fsdp", 6).describe() == {"fsdp": 6}
+    assert MeshPlan.parse("fsdp,tp=2", 8).describe() == {"fsdp": 4, "tp": 2}
+    assert MeshPlan.parse("fsdp=2,tp=2", 8).describe() == {
+        "dp": 2,
+        "fsdp": 2,
+        "tp": 2,
+    }
+    assert MeshPlan.parse("", 4).describe() == {"dp": 4}
+    with pytest.raises(ValueError):
+        MeshPlan.parse("fsdp,tp=3", 8)  # 3 does not divide 8
+    with pytest.raises(ValueError):
+        MeshPlan.parse("warp=2", 4)  # unknown axis
+    with pytest.raises(ValueError):
+        MeshPlan.parse("tp,tp=2", 8)  # growth axis also pinned
+
+
+def test_mesh_spec_growth_roundtrip():
+    from edl_tpu.api.job import TrainingJob
+
+    spec = MeshSpec(fsdp=0, tp=2, growth="fsdp")
+    assert spec.to_mesh_string() == "fsdp,tp=2"
+    job = TrainingJob.from_dict(
+        {
+            "metadata": {"name": "j"},
+            "spec": {"mesh": {"tp": 2, "growth": "fsdp"}},
+        }
+    )
+    assert job.spec.mesh.growth == "fsdp"
+    assert job.spec.mesh.to_mesh_string() == "fsdp,tp=2"
+    assert job.to_dict()["spec"]["mesh"] == {"tp": 2, "growth": "fsdp"}
+    with pytest.raises(ValueError, match="growth"):
+        TrainingJob.from_dict(
+            {"metadata": {"name": "j"}, "spec": {"mesh": {"growth": "warp"}}}
+        )
+
+
 def test_fsdp_pspec_picks_divisible_dim():
     assert shd.fsdp_pspec((16, 7), 8) == P("fsdp", None)
     assert shd.fsdp_pspec((7, 24), 8) == P(None, "fsdp")
