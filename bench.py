@@ -52,6 +52,129 @@ WARMUP = 2  # chunks (CHUNK steps each) before timing
 MEASURE = 30
 CHUNK = 6  # steps fused per dispatch (lax.scan) in the measure loop
 
+# bf16 peak TFLOP/s by device kind substring (MFU denominator); the
+# public per-chip numbers for each TPU generation
+_PEAK_BF16 = (
+    ("v6", 918e12),
+    ("v5p", 459e12),
+    ("v5e", 197e12),
+    ("v5 lite", 197e12),
+    ("v5", 459e12),
+    ("v4", 275e12),
+)
+
+
+def _peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    for sub, peak in _PEAK_BF16:
+        if sub in kind:
+            return peak
+    return 197e12  # assume v5e (the bench fleet) when the kind is opaque
+
+
+def _llama_flagship_bench(n_dev, plan, mesh, rng) -> dict:
+    """Flagship train throughput + MFU. On TPU: d2048/L16/ff6144,
+    vocab 32k, T=2048, bf16 activations, pallas flash attention,
+    per-layer remat, adafactor (factored moments — Adam's 8 GB of f32
+    moments don't fit beside 3.8 GB of f32 params in 16 GB HBM).
+    Off-TPU: a tiny config keeps the script smoke-runnable."""
+    import optax
+
+    from edl_tpu.models import llama
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        lcfg = llama.LlamaConfig(
+            vocab=32768,
+            d_model=2048,
+            n_layers=16,
+            n_heads=16,
+            n_kv_heads=8,
+            d_ff=6144,
+            dtype=jnp.bfloat16,
+            use_flash=True,
+            remat=True,
+        )
+        lt, ladder = 2048, (16, 8, 4, 2)
+        lsteps, lreps = 2, 4  # fused steps/dispatch, dispatches/loop
+    else:  # smoke config: exercise the same code path cheaply
+        lcfg = llama.LlamaConfig(
+            vocab=1024,
+            d_model=128,
+            n_layers=2,
+            n_heads=4,
+            n_kv_heads=2,
+            d_ff=384,
+            dtype=jnp.float32,
+            remat=True,
+        )
+        lt, ladder = 256, (2,)
+        lsteps, lreps = 2, 2
+    ltx = optax.adafactor(1e-3)
+    pspecs = llama.param_pspecs(lcfg, plan)
+
+    ltok_rate, used_batch = 0.0, 0
+    for per_chip in ladder:
+        lb = per_chip * n_dev
+        ltok_rate = 0.0  # a partially-timed bigger rung must not leak in
+        lstate = ltoks = None
+        try:
+            lstate = jax.jit(
+                lambda: TrainState.create(
+                    llama.init_params(jax.random.PRNGKey(1), lcfg), ltx
+                )
+            )()
+            lstate = shard_state(lstate, plan, mesh, pspecs)
+            ltoks = stack_batches(
+                [
+                    llama.synthetic_tokens(rng, lb, lt, lcfg.vocab)
+                    for _ in range(lsteps)
+                ],
+                plan,
+                mesh,
+            )
+            lmulti = make_train_multistep(
+                llama.make_loss_fn(lcfg), ltx, plan, mesh, pspecs
+            )
+            lstate, lm = lmulti(lstate, ltoks)
+            float(lm["loss"])  # compile + warmup fence
+            for _ in range(2):
+                t3 = time.perf_counter()
+                for _ in range(lreps):
+                    lstate, lm = lmulti(lstate, ltoks)
+                float(lm["loss"])
+                ltok_rate = max(
+                    ltok_rate,
+                    lreps * lsteps * lb * lt / (time.perf_counter() - t3) / n_dev,
+                )
+            used_batch = per_chip
+            del lstate, ltoks
+            break
+        except Exception as e:
+            # OOM (or any per-rung failure: a too-big program can also
+            # kill the remote compile helper): step down; only a failure
+            # on the LAST rung propagates
+            if per_chip == ladder[-1]:
+                raise
+            print(
+                f"# llama bench: batch {per_chip}/chip failed "
+                f"({str(e)[:120]}), stepping down"
+            )
+            del lstate, ltoks  # free the failed rung's HBM first
+            jax.clear_caches()
+    peak = _peak_flops(jax.devices()[0])
+    fpt = llama.train_flops_per_token(lcfg, lt)
+    return {
+        "llama_tokens_per_sec_per_chip": round(ltok_rate, 1),
+        "mfu": round(ltok_rate * fpt / peak, 4) if on_tpu else 0.0,
+        "llama_config": (
+            f"d{lcfg.d_model}/L{lcfg.n_layers}/ff{lcfg.d_ff}/"
+            f"v{lcfg.vocab}/T{lt}/b{used_batch}"
+        ),
+        "llama_flops_per_token": round(fpt / 1e6, 1),  # MFLOPs
+        "peak_tflops": round(peak / 1e12, 1),
+    }
+
 
 def main() -> None:
     n_dev = len(jax.devices())
@@ -95,50 +218,6 @@ def main() -> None:
         best_dt = min(best_dt, time.perf_counter() - t0)
     eps_per_chip = BATCH * (MEASURE // CHUNK) * CHUNK / best_dt / n_dev
 
-    # flagship (Llama + pallas flash attention) train-step throughput:
-    # the d512/L4 graft-entry config, bf16, T=2048 causal
-    from edl_tpu.models import llama
-
-    lcfg = llama.LlamaConfig(
-        vocab=32768,
-        d_model=512,
-        n_layers=4,
-        n_heads=8,
-        n_kv_heads=4,
-        d_ff=1536,
-        dtype=jnp.bfloat16,
-        # interpret-mode pallas off-TPU would take hours; XLA attention
-        # keeps the bench smoke-runnable on a dev box
-        use_flash=jax.devices()[0].platform == "tpu",
-    )
-    lb, lt = 8 * n_dev, 2048  # 8 sequences per chip on any mesh size
-    lsteps = 2  # fused steps per dispatch
-    lreps = 4  # dispatches per timed loop
-    lstate = shard_state(
-        TrainState.create(llama.init_params(jax.random.PRNGKey(1), lcfg), tx),
-        plan,
-        mesh,
-    )
-    ltoks = stack_batches(
-        [llama.synthetic_tokens(rng, lb, lt, lcfg.vocab) for _ in range(lsteps)],
-        plan,
-        mesh,
-    )
-    lmulti = make_train_multistep(llama.make_loss_fn(lcfg), tx, plan, mesh)
-    lstate, lm = lmulti(lstate, ltoks)
-    float(lm["loss"])  # compile + warmup
-    ltok_rate = 0.0
-    for _ in range(2):
-        t3 = time.perf_counter()
-        for _ in range(lreps):
-            lstate, lm = lmulti(lstate, ltoks)
-        float(lm["loss"])
-        ltok_rate = max(
-            ltok_rate,
-            lreps * lsteps * lb * lt / (time.perf_counter() - t3) / n_dev,
-        )
-    del lstate, ltoks
-
     # reshard stall, both protocol paths on this chip, min of 2 runs
     # (host<->device bandwidth on a tunneled chip is noisy; min is the
     # standard interference-suppressing estimator):
@@ -161,6 +240,13 @@ def main() -> None:
         state3 = ckpt.staged_reshard(state3, plan, mesh)
         float(jnp.sum(state3.params["out"]["b"]))
         stall_host_s = min(stall_host_s, time.perf_counter() - t2)
+    del state, state2, state3, stacked  # free HBM for the flagship bench
+
+    # flagship Llama train-step throughput + MFU on a NON-toy config
+    # (VERDICT r1 #3: report mfu ≥ 0.40 at ≥d2048/L16, T≥2048, bf16).
+    # Runs LAST: its ~14 GB working set would fragment HBM under the
+    # reshard-stall measurements above.
+    llama_metrics = _llama_flagship_bench(n_dev, plan, mesh, rng)
 
     print(
         json.dumps(
@@ -171,7 +257,7 @@ def main() -> None:
                 "vs_baseline": 1.0,
                 "reshard_stall_s": round(stall_fast_s, 4),
                 "reshard_stall_host_fallback_s": round(stall_host_s, 4),
-                "llama_tokens_per_sec_per_chip": round(ltok_rate, 1),
+                **llama_metrics,
                 "compile_s": round(compile_s, 2),
                 "final_loss": round(float(m["loss"]), 4),
                 "n_devices": n_dev,

@@ -90,6 +90,13 @@ class PserverSpec:
     resources: ResourceRequirements = field(default_factory=ResourceRequirements)
 
 
+# Axes over which a batch is split (each shard sees different examples);
+# consumed by parallel/mesh.py. Lives here (the jax-free API layer) so
+# manifest validation and mesh construction share one definition — only
+# these axes may be a MeshSpec growth axis.
+BATCH_AXES: tuple = ("dp", "fsdp")
+
+
 @dataclass
 class MeshSpec:
     """Parallelism plan: per-axis sizes of the device mesh each worker set
@@ -270,12 +277,13 @@ class TrainingJob:
             )
         except (TypeError, ValueError) as e:
             raise ValueError(f"invalid mesh spec {mesh_d!r}: {e}") from e
-        if mesh.growth not in ("dp", "fsdp"):
+        if mesh.growth not in BATCH_AXES:
             # only batch axes can absorb elastic membership change (see
             # MeshPlan.parse); tp/pp/sp/ep growth would silently change
             # per-process batch rows under a fixed queue chunk
             raise ValueError(
-                f"mesh growth axis must be dp or fsdp, got {mesh.growth!r}"
+                f"mesh growth axis must be one of {BATCH_AXES}, "
+                f"got {mesh.growth!r}"
             )
         if mesh.axis_sizes().get(mesh.growth):
             raise ValueError(

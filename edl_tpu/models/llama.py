@@ -39,6 +39,11 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16  # activation dtype (params stay f32)
     use_flash: bool = False  # pallas flash attention (TPU, T % 128 == 0)
+    # rematerialize each layer in the backward pass: only the [B,T,d]
+    # layer inputs are saved across the scan, trading ~33% more forward
+    # FLOPs for O(L·B·T·d) instead of O(L·B·T·(d+ff+heads)) activation
+    # HBM — what lets non-toy configs train on one chip
+    remat: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -205,9 +210,28 @@ def forward(params: Dict, tokens: jnp.ndarray, cfg: LlamaConfig) -> jnp.ndarray:
     def body(carry, lp):
         return _layer(cfg, carry, lp), None
 
+    if cfg.remat:
+        body = jax.checkpoint(body)
     x, _ = jax.lax.scan(body, x, params["layers"])
     x = _rmsnorm(x, params["ln_f"], cfg.norm_eps)
     return (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+
+
+def train_flops_per_token(cfg: LlamaConfig, seq: int) -> float:
+    """Model FLOPs per trained token (fwd+bwd), the MFU numerator:
+    6 × matmul params (embedding lookup excluded, lm_head included)
+    plus causal attention 12·L·(T/2)·d_attn. Remat recompute is NOT
+    counted (MFU convention: model FLOPs, not hardware FLOPs)."""
+    hd = cfg.head_dim
+    per_layer = (
+        cfg.d_model * cfg.n_heads * hd  # wq
+        + 2 * cfg.d_model * cfg.n_kv_heads * hd  # wk, wv
+        + cfg.n_heads * hd * cfg.d_model  # wo
+        + 3 * cfg.d_model * cfg.d_ff  # w1, w3, w2
+    )
+    n_matmul = cfg.n_layers * per_layer + cfg.d_model * cfg.vocab
+    attn = 12.0 * cfg.n_layers * (seq / 2.0) * (cfg.n_heads * hd)
+    return 6.0 * n_matmul + attn
 
 
 def make_loss_fn(cfg: LlamaConfig):

@@ -27,12 +27,9 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from edl_tpu.api.job import MeshSpec
+from edl_tpu.api.job import BATCH_AXES, MeshSpec
 
 AXIS_ORDER: Tuple[str, ...] = ("dp", "pp", "fsdp", "sp", "ep", "tp")
-
-# Axes over which a batch is split (each shard sees different examples).
-BATCH_AXES: Tuple[str, ...] = ("dp", "fsdp")
 
 
 @dataclass(frozen=True)

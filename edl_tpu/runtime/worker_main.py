@@ -103,6 +103,7 @@ class WorkerConfig:
     ckpt_commit_timeout_s: float = 300.0
     seed: int = 0
     vocab: int = 4096  # ctr/llama hash/token space (small for tests)
+    emb: int = 0  # ctr embedding dim override (0 = model default)
     seq_len: int = 64  # llama sequence length
     rendezvous_timeout_s: float = 120.0
     step_sleep_s: float = 0.0  # throttle (tests: keeps jobs scalable mid-run)
@@ -136,6 +137,7 @@ class WorkerConfig:
             ),
             seed=int(e.get("EDL_SEED", "0")),
             vocab=int(e.get("EDL_VOCAB", "4096")),
+            emb=int(e.get("EDL_EMB", "0")),
             seq_len=int(e.get("EDL_SEQ_LEN", "64")),
             rendezvous_timeout_s=float(e.get("EDL_RENDEZVOUS_TIMEOUT_S", "120")),
             step_sleep_s=float(e.get("EDL_STEP_SLEEP_S", "0")),
@@ -188,8 +190,11 @@ def _ctr_workload(cfg: WorkerConfig) -> Workload:
         r = np.random.RandomState(cfg.seed * 1_000_003 + start + 1)
         return ctr.synthetic_batch(r, end - start, vocab=cfg.vocab)
 
+    emb_kw = {"emb": cfg.emb} if cfg.emb else {}
     return Workload(
-        lambda: ctr.init_params(jax.random.PRNGKey(cfg.seed), vocab=cfg.vocab),
+        lambda: ctr.init_params(
+            jax.random.PRNGKey(cfg.seed), vocab=cfg.vocab, **emb_kw
+        ),
         ctr.make_loss_fn(),
         batch_fn,
     )
@@ -468,9 +473,20 @@ class ElasticWorker:
                 manifest=manifest,
             )
             log.info("restored", step=int(manifest["step"]))
-        elif self._ram_snapshot is not None:
+        elif (
+            self._ram_snapshot is not None and self._ram_snapshot.is_complete()
+        ):
             state = ckpt.restore_local(like, state_sh, self._ram_snapshot)
         else:
+            # job start — or an fsdp crash before ANY commit existed
+            # (nothing restorable: the dead peer's shards are gone and
+            # no manifest was written); restart the job's math from
+            # step 0 rather than killing every survivor
+            if self._ram_snapshot is not None:
+                log.warn(
+                    "no committed checkpoint and local snapshot is "
+                    "partial; reinitializing from step 0"
+                )
             state = jax.jit(
                 lambda: TrainState.create(wl.init_params(), tx),
                 out_shardings=state_sh,

@@ -233,6 +233,58 @@ def test_llama_fsdp_crash_sigkill_rank0_rolls_back_to_commit(tmp_path):
         assert ckpt.latest_manifest(launcher.ckpt_dir) is not None
 
 
+def test_100m_param_fsdp_ckpt_written_at_4_resumed_at_2_and_8(tmp_path):
+    """VERDICT r1 #2 done-criterion: a ≥100M-param FSDP state committed
+    at world=4 resumes at world=2 AND world=8, with per-host I/O (and
+    therefore RAM) bounded by local shard bytes — each rank file holds
+    ~1/world of the state, not all of it. Model: CTR with a 1.6M×64
+    embedding (102M params, ~1.2 GB of f32 state with Adam moments)."""
+    big = dict(
+        model="ctr",
+        mesh="fsdp",
+        n_samples=32,
+        passes=1,
+        per_device_batch=4,
+        local_devices=1,
+        extra_env={"EDL_VOCAB": "1600000", "EDL_EMB": "64"},
+    )
+    wd = str(tmp_path)
+    with ProcessJobLauncher(
+        job="big4", min_workers=4, max_workers=4, work_dir=wd, **big
+    ) as l4:
+        l4.start(4)
+        rcs = l4.wait(timeout_s=600)
+        _assert_succeeded(l4, rcs)
+        m = ckpt.latest_manifest(l4.ckpt_dir)
+        assert m is not None and len(m["files"]) == 4
+        total = sum(
+            os.path.getsize(os.path.join(m["_dir"], f)) for f in m["files"]
+        )
+        assert total > 4 * 100e6 * 1.2  # >100M params of f32 + moments on disk
+        for f in m["files"]:
+            sz = os.path.getsize(os.path.join(m["_dir"], f))
+            # per-rank file bounded by ~1/world of the state (+small
+            # replicated leaves on the leader's file)
+            assert sz < total / 4 * 1.5, (f, sz, total)
+        step4 = m["step"]
+
+    for world, jobname in ((2, "big2"), (8, "big8")):
+        with ProcessJobLauncher(
+            job=jobname,
+            min_workers=world,
+            max_workers=world,
+            work_dir=wd,  # same ckpt dir: resume from the world-4 commit
+            **big,
+        ) as ln:
+            ln.start(world)
+            rcs = ln.wait(timeout_s=900)
+            _assert_succeeded(ln, rcs)
+            m2 = ckpt.latest_manifest(ln.ckpt_dir)
+            assert m2["step"] > step4  # continued, not restarted
+            assert len(m2["files"]) == world
+            step4 = m2["step"]
+
+
 def test_crash_sigkill_rank0_survivors_recover(tmp_path):
     """Worst case: the dead worker is rank 0 — it hosted the JAX
     coordination service AND published the per-step go decisions.
