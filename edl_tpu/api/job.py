@@ -24,7 +24,7 @@ from __future__ import annotations
 
 import enum
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 from edl_tpu.api.resources import ResourceRequirements, ResourceSpec
 
@@ -69,6 +69,29 @@ class ResourceState(str, enum.Enum):
     READY = "ready"
     SUCCEEDED = "succeeded"
     FAILED = "failed"
+
+
+@dataclass
+class VolumeSpec:
+    """A named pod volume (reference: TrainingJobSpec.Volumes,
+    pkg/apis/paddlepaddle/v1/types.go:54). ``source`` is the k8s volume
+    source passed through verbatim (hostPath, persistentVolumeClaim,
+    emptyDir, configMap, …) — typed enough to validate, open enough to
+    carry any cluster's storage."""
+
+    name: str
+    source: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class VolumeMountSpec:
+    """Where a declared volume lands in every job pod (reference:
+    TrainingJobSpec.VolumeMounts, types.go:55-56 — mounted into master,
+    pserver, and trainer pods alike; here: coordinator + workers)."""
+
+    name: str
+    mount_path: str
+    read_only: bool = False
 
 
 @dataclass
@@ -173,6 +196,14 @@ class TrainingJobSpec:
     # committed checkpoint. 0 = commit only at reshard/stop.
     checkpoint_dir: str = ""
     checkpoint_every: int = 0
+    # on-disk dataset root (runtime/shards.py manifest layout), usually
+    # under a volume mount — workers then train on real files through
+    # the lease queue instead of synthetic batches
+    data_dir: str = ""
+    # pod volumes + mounts (reference: types.go:54-56) — how real jobs
+    # see datasets and checkpoint stores
+    volumes: List[VolumeSpec] = field(default_factory=list)
+    volume_mounts: List[VolumeMountSpec] = field(default_factory=list)
     master: MasterSpec = field(default_factory=MasterSpec)
     pserver: PserverSpec = field(default_factory=PserverSpec)
     worker: WorkerSpec = field(default_factory=WorkerSpec)
@@ -303,6 +334,25 @@ class TrainingJob:
             mesh=mesh,
             checkpoint_dir=spec_d.get("checkpoint_dir", ""),
             checkpoint_every=int(spec_d.get("checkpoint_every", 0)),
+            data_dir=spec_d.get("data_dir", ""),
+            volumes=[
+                VolumeSpec(
+                    name=v.get("name", ""),
+                    source={k: val for k, val in v.items() if k != "name"},
+                )
+                for v in spec_d.get("volumes", []) or []
+            ],
+            volume_mounts=[
+                VolumeMountSpec(
+                    name=m.get("name", ""),
+                    mount_path=m.get("mount_path", m.get("mountPath", "")),
+                    read_only=bool(m.get("read_only", m.get("readOnly", False))),
+                )
+                for m in (
+                    spec_d.get("volume_mounts", spec_d.get("volumeMounts", []))
+                    or []
+                )
+            ],
             master=MasterSpec(
                 coordinator_endpoint=master_d.get(
                     "coordinator_endpoint", master_d.get("etcd-endpoint", "")
@@ -369,6 +419,21 @@ class TrainingJob:
             spec["checkpoint_dir"] = s.checkpoint_dir
         if s.checkpoint_every:
             spec["checkpoint_every"] = s.checkpoint_every
+        if s.data_dir:
+            spec["data_dir"] = s.data_dir
+        if s.volumes:
+            spec["volumes"] = [
+                {"name": v.name, **v.source} for v in s.volumes
+            ]
+        if s.volume_mounts:
+            spec["volume_mounts"] = [
+                {
+                    "name": m.name,
+                    "mount_path": m.mount_path,
+                    **({"read_only": True} if m.read_only else {}),
+                }
+                for m in s.volume_mounts
+            ]
         master: dict = {}
         if s.master.coordinator_endpoint:
             master["coordinator_endpoint"] = s.master.coordinator_endpoint

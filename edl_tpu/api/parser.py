@@ -25,6 +25,8 @@ from edl_tpu.api.job import (
     DEFAULT_PASSES,
     DEFAULT_PORT,
     TrainingJob,
+    VolumeMountSpec,
+    VolumeSpec,
 )
 
 
@@ -44,6 +46,8 @@ class CoordinatorPlan:
     labels: Dict[str, str] = field(default_factory=dict)
     cpu_milli: int = 0
     mem_mega: int = 0
+    volumes: List[VolumeSpec] = field(default_factory=list)
+    volume_mounts: List[VolumeMountSpec] = field(default_factory=list)
 
 
 @dataclass
@@ -68,6 +72,8 @@ class WorkerGroupPlan:
     labels: Dict[str, str] = field(default_factory=dict)
     env: Dict[str, str] = field(default_factory=dict)
     restart_policy: str = "Never"  # reference: jobparser.go:160
+    volumes: List[VolumeSpec] = field(default_factory=list)
+    volume_mounts: List[VolumeMountSpec] = field(default_factory=list)
 
 
 class JobParser:
@@ -132,6 +138,43 @@ class JobParser:
                 "is sharded across workers, so rescale/recovery needs a "
                 "shared checkpoint store"
             )
+        # volumes/mounts (reference: types.go:54-56, plumbed into every
+        # pod by the parsers)
+        vol_names = [v.name for v in s.volumes]
+        if len(vol_names) != len(set(vol_names)):
+            raise ValidationError(f"duplicate volume names: {vol_names}")
+        for v in s.volumes:
+            if not v.name:
+                raise ValidationError("volume without a name")
+            if not v.source:
+                raise ValidationError(f"volume {v.name!r} has no source")
+        for m in s.volume_mounts:
+            if m.name not in vol_names:
+                raise ValidationError(
+                    f"volume_mount {m.name!r} references no declared volume"
+                )
+            if not m.mount_path.startswith("/"):
+                raise ValidationError(
+                    f"volume_mount {m.name!r} mount_path must be absolute, "
+                    f"got {m.mount_path!r}"
+                )
+        def _under_a_mount(path: str) -> bool:
+            return any(
+                path.startswith(m.mount_path.rstrip("/") + "/")
+                or path == m.mount_path
+                for m in s.volume_mounts
+            )
+
+        if s.checkpoint_dir and s.volumes and not _under_a_mount(s.checkpoint_dir):
+            warnings.append(
+                f"checkpoint_dir {s.checkpoint_dir!r} is not under any "
+                "volume mount; workers may write to ephemeral pod storage"
+            )
+        if s.data_dir and s.volumes and not _under_a_mount(s.data_dir):
+            warnings.append(
+                f"data_dir {s.data_dir!r} is not under any volume mount; "
+                "workers will find no dataset manifest at startup"
+            )
         return warnings
 
     # -- plan builders -----------------------------------------------------
@@ -147,6 +190,8 @@ class JobParser:
             labels={"edl-job-coordinator": job.name},
             cpu_milli=s.master.resources.requests.cpu_milli,
             mem_mega=s.master.resources.requests.mem_mega,
+            volumes=list(s.volumes),
+            volume_mounts=list(s.volume_mounts),
         )
 
     def parse_to_workers(self, job: TrainingJob) -> WorkerGroupPlan:
@@ -170,6 +215,8 @@ class JobParser:
             passes=s.passes,
             labels={"edl-job": job.name},
             env=self.pod_env(job),
+            volumes=list(s.volumes),
+            volume_mounts=list(s.volume_mounts),
         )
 
     def pod_env(self, job: TrainingJob) -> Dict[str, str]:
@@ -194,6 +241,7 @@ class JobParser:
             "EDL_MESH": s.mesh.to_mesh_string(),
             "EDL_CKPT_DIR": s.checkpoint_dir,
             "EDL_CKPT_EVERY": str(s.checkpoint_every),
+            "EDL_DATA_DIR": s.data_dir,
             "EDL_COORDINATOR": s.master.coordinator_endpoint
             or f"{job.name}-coordinator:{s.port}",
         }

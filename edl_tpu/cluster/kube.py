@@ -196,6 +196,22 @@ def _tj_path(namespace: str, name: str = "", subresource: str = "") -> str:
     return p
 
 
+def _volumes_block(plan) -> Tuple[list, list]:
+    """(pod volumes, container volumeMounts) from a plan's volume specs
+    (reference: Volumes/VolumeMounts plumbed into every pod template,
+    pkg/apis/paddlepaddle/v1/types.go:54-56)."""
+    vols = [{"name": v.name, **v.source} for v in plan.volumes]
+    mounts = [
+        {
+            "name": m.name,
+            "mountPath": m.mount_path,
+            **({"readOnly": True} if m.read_only else {}),
+        }
+        for m in plan.volume_mounts
+    ]
+    return vols, mounts
+
+
 def _resources_block(cpu_m: int, mem_m: int, chips: int) -> dict:
     req: Dict[str, object] = {}
     if cpu_m:
@@ -298,6 +314,7 @@ class KubeCluster(Cluster):
         node_selector = {}
         if plan.accelerator_type:
             node_selector[TPU_ACCELERATOR_NODE_LABEL] = plan.accelerator_type
+        vols, mounts = _volumes_block(plan)
         return {
             "apiVersion": "batch/v1",
             "kind": "Job",
@@ -316,6 +333,7 @@ class KubeCluster(Cluster):
                     "spec": {
                         "restartPolicy": plan.restart_policy,
                         "nodeSelector": node_selector,
+                        **({"volumes": vols} if vols else {}),
                         "containers": [
                             {
                                 "name": "worker",
@@ -327,6 +345,9 @@ class KubeCluster(Cluster):
                                     "edl_tpu.runtime.worker_main",
                                 ],
                                 "env": env,
+                                **(
+                                    {"volumeMounts": mounts} if mounts else {}
+                                ),
                                 "resources": _resources_block(
                                     plan.cpu_milli,
                                     plan.mem_mega,
@@ -408,6 +429,7 @@ class KubeCluster(Cluster):
     #    master RS analog, reference: CreateReplicaSet :253) ---------------
 
     def create_coordinator(self, plan: CoordinatorPlan) -> Coordinator:
+        vols, mounts = _volumes_block(plan)
         manifest = {
             "apiVersion": "apps/v1",
             "kind": "Deployment",
@@ -422,6 +444,7 @@ class KubeCluster(Cluster):
                 "template": {
                     "metadata": {"labels": dict(plan.labels)},
                     "spec": {
+                        **({"volumes": vols} if vols else {}),
                         "containers": [
                             {
                                 "name": "coordinator",
@@ -434,6 +457,9 @@ class KubeCluster(Cluster):
                                     "--port", str(plan.port),
                                 ],
                                 "ports": [{"containerPort": plan.port}],
+                                **(
+                                    {"volumeMounts": mounts} if mounts else {}
+                                ),
                                 "resources": _resources_block(
                                     plan.cpu_milli, plan.mem_mega, 0
                                 ),

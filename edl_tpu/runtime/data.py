@@ -188,9 +188,13 @@ class QueueBatcher:
             total += next(iter(arrays.values())).shape[0] - offset
         return total
 
-    def next_batch(self, batch_size: int):
+    def next_batch(self, batch_size: int, rollover: bool = False):
         """Next batch dict, or None when the queue is drained. The final
-        batch may be short (callers pad or drop)."""
+        batch may be short (callers pad or drop). With ``rollover`` a
+        short batch at a pass boundary is topped up from the next pass
+        (leases advance epochs), so batches stay full-size until the
+        true end of the queue — the streaming mode long-running trainers
+        want."""
         import numpy as _np
 
         while self._buffered() < batch_size:
@@ -200,6 +204,16 @@ class QueueBatcher:
             self._buffer.append((task.task_id, self.fetch(task), 0))
         if not self._buffer:
             return None
+        if rollover and self._buffered() < batch_size:
+            head = self.next_batch(self._buffered())  # drain the tail...
+            rest = self.next_batch(batch_size - next(
+                iter(head.values())
+            ).shape[0])  # ...then pull from the next pass
+            if rest is None:
+                return head
+            return {
+                k: _np.concatenate([head[k], rest[k]], axis=0) for k in head
+            }
         need = batch_size
         pieces: List = []
         new_buffer = []

@@ -55,6 +55,7 @@ class ProcessJobLauncher:
     ckpt_every: int = 0  # periodic sharded-commit cadence (steps)
     seed: int = 0
     seq_len: int = 32  # llama workload sequence length
+    data_dir: str = ""  # on-disk dataset (runtime/shards.py layout)
     step_sleep_s: float = 0.0
     extra_env: Dict[str, str] = field(default_factory=dict)
 
@@ -91,6 +92,7 @@ class ProcessJobLauncher:
                 "EDL_MESH": self.mesh,
                 "EDL_CKPT_EVERY": str(self.ckpt_every),
                 "EDL_SEQ_LEN": str(self.seq_len),
+                "EDL_DATA_DIR": self.data_dir,
                 "EDL_LOCAL_DEVICES": str(self.local_devices),
                 "EDL_PER_DEVICE_BATCH": str(self.per_device_batch),
                 "EDL_NUM_SAMPLES": str(self.n_samples),

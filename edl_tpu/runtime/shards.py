@@ -1,0 +1,133 @@
+"""File-backed dataset shards — real data through the elastic queue.
+
+The reference trains from pre-baked on-disk shards: RecordIO files baked
+into the job image (reference: example/fit_a_line/Dockerfile:1-8) or
+downloaded per trainer (reference: example/ctr/ctr/train.py:222-227,
+``hash(file) % 10 == trainer_id``). The TPU design replaces RecordIO
+with npz shard files + a JSON manifest; the *assignment* of data to
+workers stays with the coordinator's lease queue (runtime/data.py), so
+any worker can materialize any leased [start, end) range regardless of
+which files hold it — the property that makes the data plane elastic.
+
+Layout of a dataset directory::
+
+    manifest.json                {"n_samples": N, "keys": [...], "files":
+                                  [{"file": ..., "start": s, "end": e}]}
+    shard-00000.npz              arrays for samples [start, end)
+    shard-00001.npz              ...
+
+``write_shards`` builds one (the Dockerfile-prebake analog);
+``FileShardSource`` reads ranges lazily — only the files overlapping a
+requested range are opened, so a worker's I/O is proportional to the
+data it actually leases.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Dict, List, Optional
+
+import numpy as np
+
+MANIFEST = "manifest.json"
+
+
+def write_shards(
+    data_dir: str,
+    arrays: Dict[str, np.ndarray],
+    shard_size: int = 4096,
+) -> dict:
+    """Cut column arrays (equal leading dims) into npz shard files +
+    manifest. Atomic per file; the manifest is written LAST so a
+    partially-written dataset is never readable."""
+    if not arrays:
+        raise ValueError("no arrays to shard")
+    n = next(iter(arrays.values())).shape[0]
+    for k, v in arrays.items():
+        if v.shape[0] != n:
+            raise ValueError(
+                f"array {k!r} has {v.shape[0]} samples, expected {n}"
+            )
+    if shard_size <= 0:
+        raise ValueError("shard_size must be positive")
+    os.makedirs(data_dir, exist_ok=True)
+    files: List[dict] = []
+    for i, start in enumerate(range(0, n, shard_size)):
+        end = min(start + shard_size, n)
+        fname = f"shard-{i:05d}.npz"
+        fd, tmp = tempfile.mkstemp(dir=data_dir, suffix=".npz.tmp")
+        os.close(fd)
+        with open(tmp, "wb") as f:
+            np.savez(f, **{k: v[start:end] for k, v in arrays.items()})
+        os.replace(tmp, os.path.join(data_dir, fname))
+        files.append({"file": fname, "start": start, "end": end})
+    manifest = {
+        "n_samples": n,
+        "keys": sorted(arrays.keys()),
+        "files": files,
+    }
+    fd, tmp = tempfile.mkstemp(dir=data_dir, suffix=".json.tmp")
+    os.close(fd)
+    with open(tmp, "w") as f:
+        json.dump(manifest, f)
+    os.replace(tmp, os.path.join(data_dir, MANIFEST))
+    return manifest
+
+
+class FileShardSource:
+    """Random-range access over a shard directory.
+
+    ``fetch_range(start, end)`` assembles the rows [start, end) from
+    whichever files overlap — the ``QueueBatcher.fetch`` /
+    worker ``batch_fn`` adapter for real on-disk data.
+    """
+
+    def __init__(self, data_dir: str):
+        self.data_dir = data_dir
+        path = os.path.join(data_dir, MANIFEST)
+        if not os.path.exists(path):
+            raise FileNotFoundError(
+                f"no dataset manifest at {path}; run write_shards first"
+            )
+        with open(path) as f:
+            self.manifest = json.load(f)
+        self.n_samples: int = int(self.manifest["n_samples"])
+        self.keys: List[str] = list(self.manifest["keys"])
+        self._files = self.manifest["files"]
+        self._cache: Dict[str, dict] = {}  # one decoded shard kept hot
+
+    def _load(self, entry: dict) -> dict:
+        fname = entry["file"]
+        if fname not in self._cache:
+            self._cache.clear()  # LRU of size 1: sequential reads hit it
+            with np.load(
+                os.path.join(self.data_dir, fname), allow_pickle=False
+            ) as z:
+                self._cache[fname] = {k: z[k] for k in z.files}
+        return self._cache[fname]
+
+    def fetch_range(self, start: int, end: int) -> Dict[str, np.ndarray]:
+        if not 0 <= start < end <= self.n_samples:
+            raise IndexError(
+                f"range [{start}, {end}) outside dataset of {self.n_samples}"
+            )
+        pieces: List[dict] = []
+        for entry in self._files:
+            lo, hi = max(start, entry["start"]), min(end, entry["end"])
+            if lo >= hi:
+                continue
+            data = self._load(entry)
+            s = lo - entry["start"]
+            pieces.append({k: data[k][s : s + (hi - lo)] for k in self.keys})
+        if len(pieces) == 1:
+            return pieces[0]
+        return {
+            k: np.concatenate([p[k] for p in pieces], axis=0)
+            for k in self.keys
+        }
+
+    def fetch(self, task) -> Dict[str, np.ndarray]:
+        """QueueBatcher-compatible: task carries [start, end)."""
+        return self.fetch_range(task.start, task.end)

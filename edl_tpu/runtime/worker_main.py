@@ -105,6 +105,12 @@ class WorkerConfig:
     vocab: int = 4096  # ctr/llama hash/token space (small for tests)
     emb: int = 0  # ctr embedding dim override (0 = model default)
     seq_len: int = 64  # llama sequence length
+    # on-disk dataset (runtime/shards.py manifest dir, usually a mounted
+    # volume). When set, leased tasks read REAL rows from shard files
+    # instead of synthesizing them, and n_samples comes from the
+    # manifest (reference: pre-baked RecordIO shards,
+    # example/fit_a_line/Dockerfile:1-8).
+    data_dir: str = ""
     rendezvous_timeout_s: float = 120.0
     step_sleep_s: float = 0.0  # throttle (tests: keeps jobs scalable mid-run)
 
@@ -139,6 +145,7 @@ class WorkerConfig:
             vocab=int(e.get("EDL_VOCAB", "4096")),
             emb=int(e.get("EDL_EMB", "0")),
             seq_len=int(e.get("EDL_SEQ_LEN", "64")),
+            data_dir=e.get("EDL_DATA_DIR", ""),
             rendezvous_timeout_s=float(e.get("EDL_RENDEZVOUS_TIMEOUT_S", "120")),
             step_sleep_s=float(e.get("EDL_STEP_SLEEP_S", "0")),
         )
@@ -587,6 +594,19 @@ class ElasticWorker:
         from edl_tpu.parallel.mesh import MeshPlan
 
         wl = WORKLOADS[cfg.model](cfg)
+        if cfg.data_dir:
+            # real on-disk data: leased [start, end) ranges read shard
+            # files instead of the workload's synthetic generator
+            from edl_tpu.runtime.shards import FileShardSource
+
+            source = FileShardSource(cfg.data_dir)
+            wl = Workload(
+                wl.init_params, wl.loss_fn, source.fetch_range, wl.pspecs
+            )
+            cfg.n_samples = source.n_samples
+            log.info(
+                "dataset attached", dir=cfg.data_dir, n_samples=cfg.n_samples
+            )
         tx = optax.adam(1e-2 if cfg.model == "linreg" else 1e-3)
 
         if self._leaving:  # SIGTERM during startup: never joined
@@ -751,8 +771,12 @@ class ElasticWorker:
             return local, task.task_id
         if self._last_local is not None:
             return self._last_local, None
-        # first-ever step with no task: zero batch of chunk shape
-        probe = batch_fn(0, chunk)
+        # first-ever step with no task: zero batch of chunk shape (probe
+        # only what the dataset has — a file-backed source bounds-checks,
+        # and the dataset may be smaller than one process's rows)
+        probe = self._pad_to(
+            batch_fn(0, min(chunk, self.cfg.n_samples)), chunk
+        )
         return {
             k: np.zeros_like(v) for k, v in probe.items()
         }, None

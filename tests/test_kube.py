@@ -11,6 +11,7 @@ meant for but never got.
 
 import pytest
 
+from edl_tpu.api import job as job_api
 from edl_tpu.api.job import JobPhase, TrainingJob
 from edl_tpu.api.parser import JobParser
 from edl_tpu.cluster.base import ConflictError
@@ -119,6 +120,60 @@ def test_worker_job_manifest_shape(server, cluster):
     assert env["EDL_WORKERS_MAX"] == "8"
     assert env["EDL_FAULT_TOLERANT"] == "1"
     assert env["EDL_COORDINATOR"].startswith("demo-coordinator:")
+
+
+def test_volumes_rendered_into_pod_templates(server, cluster):
+    """Volumes/VolumeMounts (reference: types.go:54-56) land in BOTH the
+    worker Job and the coordinator Deployment pod specs — plus the
+    EDL_DATA_DIR/EDL_CKPT_DIR env contract pointing into the mounts."""
+    job = _job(name="vol")
+    job.spec.data_dir = "/data/ds"
+    job.spec.checkpoint_dir = "/ckpt/vol"
+    job.spec.volumes = [
+        job_api.VolumeSpec("dataset", {"persistentVolumeClaim": {"claimName": "ds"}}),
+        job_api.VolumeSpec("ckpt", {"hostPath": {"path": "/mnt/ckpt"}}),
+    ]
+    job.spec.volume_mounts = [
+        job_api.VolumeMountSpec("dataset", "/data", read_only=True),
+        job_api.VolumeMountSpec("ckpt", "/ckpt"),
+    ]
+    parser = JobParser()
+    assert parser.validate(job) == []  # ckpt under a mount: no warnings
+    cluster.create_worker_group(parser.parse_to_workers(job))
+    cluster.create_coordinator(parser.parse_to_coordinator(job))
+
+    obj = server.get_object("batch/v1", "jobs", "default", "vol-worker")
+    pod = obj["spec"]["template"]["spec"]
+    assert {v["name"] for v in pod["volumes"]} == {"dataset", "ckpt"}
+    assert pod["volumes"][0]["persistentVolumeClaim"] == {"claimName": "ds"}
+    c = pod["containers"][0]
+    assert c["volumeMounts"] == [
+        {"name": "dataset", "mountPath": "/data", "readOnly": True},
+        {"name": "ckpt", "mountPath": "/ckpt"},
+    ]
+    env = {e["name"]: e["value"] for e in c["env"]}
+    assert env["EDL_DATA_DIR"] == "/data/ds"
+    assert env["EDL_CKPT_DIR"] == "/ckpt/vol"
+
+    dep = server.get_object("apps/v1", "deployments", "default", "vol-coordinator")
+    dpod = dep["spec"]["template"]["spec"]
+    assert {v["name"] for v in dpod["volumes"]} == {"dataset", "ckpt"}
+    assert dpod["containers"][0]["volumeMounts"][0]["mountPath"] == "/data"
+
+
+def test_volume_validation_rejects_bad_mounts():
+    job = _job(name="badvol")
+    job.spec.volumes = [job_api.VolumeSpec("a", {"emptyDir": {}})]
+    job.spec.volume_mounts = [job_api.VolumeMountSpec("missing", "/x")]
+    with pytest.raises(Exception, match="references no declared volume"):
+        JobParser().validate(job)
+    job.spec.volume_mounts = [job_api.VolumeMountSpec("a", "relative/path")]
+    with pytest.raises(Exception, match="absolute"):
+        JobParser().validate(job)
+    job.spec.volume_mounts = []
+    job.spec.volumes.append(job_api.VolumeSpec("a", {"emptyDir": {}}))
+    with pytest.raises(Exception, match="duplicate"):
+        JobParser().validate(job)
 
 
 def test_non_ft_job_gets_zero_backoff(server, cluster):

@@ -233,6 +233,42 @@ def test_llama_fsdp_crash_sigkill_rank0_rolls_back_to_commit(tmp_path):
         assert ckpt.latest_manifest(launcher.ckpt_dir) is not None
 
 
+def test_workers_train_from_on_disk_shards(tmp_path):
+    """Real data through the process runtime: CTR rows pre-written as
+    shard files (EDL_DATA_DIR), leased through the coordinator queue,
+    and read off disk by every worker (reference: per-trainer shard
+    download, example/ctr/ctr/train.py:222-227)."""
+    import numpy as np
+
+    from edl_tpu.models import ctr
+    from edl_tpu.runtime.shards import FileShardSource, write_shards
+
+    rng = np.random.RandomState(7)
+    rows = ctr.synthetic_batch(rng, 2048, vocab=4096)
+    data_dir = str(tmp_path / "ds")
+    write_shards(data_dir, rows, shard_size=512)
+
+    with ProcessJobLauncher(
+        job="mpdata",
+        model="ctr",
+        min_workers=2,
+        max_workers=2,
+        n_samples=999999,  # ignored: the manifest wins
+        passes=1,
+        per_device_batch=32,
+        data_dir=data_dir,
+        work_dir=str(tmp_path),
+        extra_env={"EDL_VOCAB": "4096"},
+    ) as launcher:
+        launcher.start(2)
+        rcs = launcher.wait(timeout_s=240)
+        _assert_succeeded(launcher, rcs)
+        # the queue was sized from the manifest (2048 rows), not the
+        # env's bogus n_samples: 2048/(32 rows × 2 workers) = 32 steps
+        assert launcher.progress() == 2048 // (32 * 2)
+        assert float(launcher.kv("loss_last")) < float(launcher.kv("loss_first"))
+
+
 def test_100m_param_fsdp_ckpt_written_at_4_resumed_at_2_and_8(tmp_path):
     """VERDICT r1 #2 done-criterion: a ≥100M-param FSDP state committed
     at world=4 resumes at world=2 AND world=8, with per-host I/O (and
