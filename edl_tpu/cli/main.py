@@ -45,6 +45,15 @@ log = kv_logger("cli")
 # ---------------------------------------------------------------------------
 
 
+def _slice_policy(args):
+    """CLI slice-policy choice -> what Autoscaler expects ("auto" stays
+    a string; names resolve to the callables)."""
+    from edl_tpu.cluster import topology
+
+    name = getattr(args, "slice_policy", "flexible")
+    return "auto" if name == "auto" else topology.POLICIES[name]
+
+
 def _build_cluster(args):
     from edl_tpu.cluster.fake import FakeCluster, FakeHost
 
@@ -97,6 +106,7 @@ def run_controller_kube(args) -> int:
         autoscaler=Autoscaler(
             cluster,
             max_load_desired=args.max_load_desired,
+            slice_policy=_slice_policy(args),
             use_native=not args.no_native_scheduler,
         ),
     )
@@ -184,6 +194,7 @@ def run_controller(args) -> int:
         autoscaler=Autoscaler(
             cluster,
             max_load_desired=args.max_load_desired,
+            slice_policy=_slice_policy(args),
             use_native=not args.no_native_scheduler,
         ),
     )
@@ -416,6 +427,14 @@ def build_parser() -> argparse.ArgumentParser:
         "--no-native-scheduler",
         action="store_true",
         help="plan in Python instead of the C++ core (native/scheduler)",
+    )
+    c.add_argument(
+        "--slice-policy",
+        choices=["flexible", "pow2", "auto"],
+        default="flexible",
+        help="slice-shape legality: flexible (reference parity), pow2, "
+        "or auto (per job from spec.accelerator_type: catalog-capped "
+        "pow2 with ICI-contiguous placement for TPU families)",
     )
     c.set_defaults(fn=run_controller)
 

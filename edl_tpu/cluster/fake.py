@@ -34,13 +34,17 @@ log = kv_logger("fakecluster")
 
 @dataclass
 class FakeHost:
-    """One host VM attached to ``chips`` TPU chips."""
+    """One host VM attached to ``chips`` TPU chips. ``ici_block`` +
+    ``ici_index`` place the host on a physical slice (see
+    resource.Hosts); leave defaults for DCN-only hosts."""
 
     name: str
     cpu_milli: int
     mem_mega: int
     chips: int = 0
     accelerator: str = "v5e"
+    ici_block: str = ""
+    ici_index: int = -1
 
 
 @dataclass
@@ -123,6 +127,16 @@ class FakeCluster(Cluster):
                 cpu_idle_milli={h.name: h.cpu_milli for h in self.hosts.values()},
                 mem_free_mega={h.name: h.mem_mega for h in self.hosts.values()},
                 chips_free={h.name: h.chips for h in self.hosts.values()},
+                ici_block={
+                    h.name: h.ici_block
+                    for h in self.hosts.values()
+                    if h.ici_block
+                },
+                ici_index={
+                    h.name: h.ici_index
+                    for h in self.hosts.values()
+                    if h.ici_block
+                },
             )
             for p in self.pods.values():
                 if p.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED):

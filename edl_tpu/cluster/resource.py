@@ -15,11 +15,22 @@ from typing import Dict
 
 @dataclass
 class Hosts:
-    """Per-host idle capacity (reference: Nodes, pkg/cluster.go:51-56)."""
+    """Per-host idle capacity (reference: Nodes, pkg/cluster.go:51-56).
+
+    ``ici_block``/``ici_index`` describe physical slice topology: hosts
+    sharing a block are on one ICI domain (a TPU pod), ordered by index
+    along the torus host dimension. Multi-host ICI placements must be
+    index-aligned contiguous windows WITHIN one block (the sub-slice
+    carving rule); hosts without block info are DCN-reachable only.
+    The reference has no analog — its per-node idle maps are flat
+    (pkg/cluster.go:51-56) because CPU placement has no contiguity.
+    """
 
     cpu_idle_milli: Dict[str, int] = field(default_factory=dict)
     mem_free_mega: Dict[str, int] = field(default_factory=dict)
     chips_free: Dict[str, int] = field(default_factory=dict)
+    ici_block: Dict[str, str] = field(default_factory=dict)
+    ici_index: Dict[str, int] = field(default_factory=dict)
 
 
 @dataclass
@@ -60,5 +71,7 @@ class ClusterResource:
                 cpu_idle_milli=dict(self.hosts.cpu_idle_milli),
                 mem_free_mega=dict(self.hosts.mem_free_mega),
                 chips_free=dict(self.hosts.chips_free),
+                ici_block=dict(self.hosts.ici_block),
+                ici_index=dict(self.hosts.ici_index),
             ),
         )

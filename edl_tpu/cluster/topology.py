@@ -60,12 +60,88 @@ def pow2(n: int) -> bool:
     return n >= 1 and (n & (n - 1)) == 0
 
 
+@dataclass(frozen=True)
+class SliceShapePolicy:
+    """Catalog-backed slice legality for one accelerator family: worker
+    (host) counts must be powers of two, bounded by the family's largest
+    pod (derived from its torus dimensionality — ``ici_degree``/2 axes:
+    2D v5e/v6e pods top out at 16x16 chips = 64 hosts, 3D v4/v5p cubes
+    at 16x16x16 = 1024 hosts), and multi-host placements must be
+    index-aligned contiguous windows within ONE physical block
+    (``contiguous``). Instances are native-expressible: the C++ planner
+    mirrors (kind, cap, contiguous) exactly."""
+
+    family: str
+    cap: int  # max hosts in one slice (largest pod of the family)
+    contiguous: bool = True
+
+    @property
+    def name(self) -> str:
+        return f"slice:{self.family}"
+
+    def __call__(self, n: int) -> bool:
+        return pow2(n) and n <= self.cap
+
+
+# Largest pod per torus dimensionality, in HOSTS (4 chips/host):
+# 2D (ici_degree 4): 16x16 chips = 256 chips = 64 hosts (v5e/v6e pods);
+# 3D (ici_degree 6): 16x16x16 chips = 4096 chips = 1024 hosts (v4/v5p).
+_SLICE_HOST_CAP = {2: 64, 3: 1024}
+
+
+def slice_policy(family_name: str) -> SliceShapePolicy:
+    fam = family(family_name)
+    dims = fam.ici_degree // 2
+    if dims not in _SLICE_HOST_CAP:
+        raise ValueError(
+            f"family {family_name!r} has no ICI torus (degree {fam.ici_degree})"
+        )
+    return SliceShapePolicy(family=fam.name, cap=_SLICE_HOST_CAP[dims])
+
+
+def slice_host_counts(family_name: str) -> List[int]:
+    """The family's legal slice catalog, in hosts."""
+    p = slice_policy(family_name)
+    return [n for n in range(1, p.cap + 1) if p(n)]
+
+
+def topology_name(family_name: str, hosts: int) -> str:
+    """Chip-grid name of a slice (e.g. v5e 8 hosts -> "4x8"), for
+    observability; "" when the count is not in the family's catalog."""
+    fam = family(family_name)
+    p = slice_policy(family_name)
+    if not p(hosts):
+        return ""
+    chips = hosts * fam.chips_per_host
+    dims = fam.ici_degree // 2
+    # split chips into `dims` pow2 factors, as square as possible,
+    # ascending — the canonical shapes (v5e: 2x2, 2x4, 4x4, 4x8, ...)
+    shape = [1] * dims
+    while chips > 1:
+        shape[shape.index(min(shape))] *= 2
+        chips //= 2
+    return "x".join(str(s) for s in sorted(shape))
+
+
+def policy_for_job(accelerator_type: str, chips_per_worker: int) -> SlicePolicy:
+    """Per-job slice legality from the job's own accelerator type
+    (reference analog surpassed: one global searchAssignableNode rule,
+    pkg/autoscaler.go:191-199). Chip-less jobs and families without an
+    ICI torus place flexibly over DCN."""
+    fam = FAMILIES.get(accelerator_type)
+    if fam is None or chips_per_worker <= 0 or fam.ici_degree < 4:
+        return flexible
+    return slice_policy(accelerator_type)
+
+
 POLICIES: Dict[str, SlicePolicy] = {"flexible": flexible, "pow2": pow2}
 
 
 def policy_name(policy: SlicePolicy) -> str:
     """Registry name of a built-in policy, or "" for a custom callable
     (custom policies are Python-only — the native planner can't run them)."""
+    if isinstance(policy, SliceShapePolicy):
+        return policy.name
     for name, p in POLICIES.items():
         if p is policy:
             return name

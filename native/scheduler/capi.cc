@@ -9,30 +9,37 @@
 
 extern "C" {
 
-// jobs_*: length n_jobs. hosts_*: length n_hosts, pre-sorted by host
-// name (placement order is observable). out_diff: length n_jobs.
-// Returns 0 on success, nonzero on bad args.
+// jobs_*: length n_jobs (policy kind/cap/contiguous are per-job — the
+// "auto" slice-policy mode resolves a different legality per job).
+// hosts_*: length n_hosts, pre-sorted by host name (placement order is
+// observable); host_block ids ascend in block-name order, -1 = no block.
+// out_diff: length n_jobs. Returns 0 on success, nonzero on bad args.
 int edl_sched_plan(int64_t n_jobs, const int64_t* job_min,
                    const int64_t* job_max, const int64_t* job_parallelism,
                    const int64_t* job_chips, const int64_t* job_cpu_milli,
-                   const int64_t* job_mem_mega, int64_t n_hosts,
-                   const int64_t* host_cpu_idle, const int64_t* host_mem_free,
-                   const int64_t* host_chips_free, int64_t chip_total,
-                   int64_t chip_limit, int64_t cpu_total_milli,
-                   int64_t cpu_request_milli, int64_t mem_total_mega,
-                   int64_t mem_request_mega, double max_load_desired,
-                   int32_t policy, int64_t* out_diff) {
+                   const int64_t* job_mem_mega, const int32_t* job_policy_kind,
+                   const int64_t* job_policy_cap, const int32_t* job_contiguous,
+                   int64_t n_hosts, const int64_t* host_cpu_idle,
+                   const int64_t* host_mem_free, const int64_t* host_chips_free,
+                   const int64_t* host_block, const int64_t* host_index,
+                   int64_t chip_total, int64_t chip_limit,
+                   int64_t cpu_total_milli, int64_t cpu_request_milli,
+                   int64_t mem_total_mega, int64_t mem_request_mega,
+                   double max_load_desired, int64_t* out_diff) {
   if (n_jobs < 0 || n_hosts < 0 || out_diff == nullptr) return 1;
-  if (policy != 0 && policy != 1) return 2;
 
   std::vector<edlsched::Job> jobs(static_cast<size_t>(n_jobs));
   for (int64_t i = 0; i < n_jobs; ++i) {
+    if (job_policy_kind[i] != 0 && job_policy_kind[i] != 1) return 2;
     jobs[i].min_replicas = job_min[i];
     jobs[i].max_replicas = job_max[i];
     jobs[i].parallelism = job_parallelism[i];
     jobs[i].chips_per_worker = job_chips[i];
     jobs[i].cpu_request_milli = job_cpu_milli[i];
     jobs[i].mem_request_mega = job_mem_mega[i];
+    jobs[i].policy_kind = static_cast<edlsched::PolicyKind>(job_policy_kind[i]);
+    jobs[i].policy_cap = job_policy_cap[i];
+    jobs[i].contiguous = job_contiguous[i] != 0;
   }
   edlsched::Resource r;
   r.chip_total = chip_total;
@@ -46,10 +53,11 @@ int edl_sched_plan(int64_t n_jobs, const int64_t* job_min,
     r.hosts[i].cpu_idle_milli = host_cpu_idle[i];
     r.hosts[i].mem_free_mega = host_mem_free[i];
     r.hosts[i].chips_free = host_chips_free[i];
+    r.hosts[i].block = host_block[i];
+    r.hosts[i].index = host_index[i];
   }
 
-  std::vector<int64_t> diff = edlsched::PlanScale(
-      jobs, r, max_load_desired, static_cast<edlsched::Policy>(policy));
+  std::vector<int64_t> diff = edlsched::PlanScale(jobs, r, max_load_desired);
   for (int64_t i = 0; i < n_jobs; ++i) out_diff[i] = diff[i];
   return 0;
 }

@@ -5,38 +5,41 @@
 
 #include <algorithm>
 #include <cmath>
+#include <map>
 #include <numeric>
 
 namespace edlsched {
 namespace {
 
-bool Legal(Policy p, int64_t n) {
-  switch (p) {
-    case Policy::kFlexible:
+// topology.SlicePolicy.__call__ (flexible / pow2 / SliceShapePolicy)
+bool Legal(const Job& j, int64_t n) {
+  switch (j.policy_kind) {
+    case PolicyKind::kFlexible:
       return n >= 0;
-    case Policy::kPow2:
-      return n >= 1 && (n & (n - 1)) == 0;
+    case PolicyKind::kPow2:
+      return n >= 1 && (n & (n - 1)) == 0 &&
+             (j.policy_cap == 0 || n <= j.policy_cap);
   }
   return false;
 }
 
 // topology.next_legal
-int64_t NextLegal(int64_t n, int64_t dir, Policy p, int64_t lo, int64_t hi) {
+int64_t NextLegal(const Job& j, int64_t n, int64_t dir, int64_t lo, int64_t hi) {
   int64_t cur = n + dir;
   if (dir > 0 && cur < lo) cur = lo;
   if (dir < 0 && cur > hi) cur = hi;
   while (lo <= cur && cur <= hi) {
-    if (Legal(p, cur)) return cur;
+    if (Legal(j, cur)) return cur;
     cur += dir;
   }
   return n;
 }
 
 // topology.floor_legal
-int64_t FloorLegal(int64_t n, Policy p, int64_t lo, int64_t hi) {
+int64_t FloorLegal(const Job& j, int64_t n, int64_t lo, int64_t hi) {
   int64_t cur = std::min(n, hi);
   while (cur >= lo) {
-    if (Legal(p, cur)) return cur;
+    if (Legal(j, cur)) return cur;
     --cur;
   }
   return n;
@@ -48,18 +51,65 @@ double Fulfillment(const Job& j) {  // autoscaler.JobState.fulfillment
          static_cast<double>(j.max_replicas - j.min_replicas);
 }
 
-// autoscaler.search_assignable_hosts: first-fit over name-sorted hosts,
+bool Fits(const Host& h, const Job& j) {
+  return j.cpu_request_milli <= h.cpu_idle_milli &&
+         j.mem_request_mega <= h.mem_free_mega &&
+         j.chips_per_worker <= h.chips_free;
+}
+
+// autoscaler._contiguous_window: an index-aligned run of n hosts within
+// ONE ICI block, each with capacity for one worker. Blocks ascend by id
+// (= block-name order, binding invariant), window starts ascend.
+bool ContiguousWindow(const Resource& r, const Job& j, int64_t n,
+                      std::vector<size_t>& placed) {
+  placed.clear();
+  // block id -> (index -> host position); std::map iterates ascending
+  std::map<int64_t, std::map<int64_t, size_t>> by_block;
+  for (size_t i = 0; i < r.hosts.size(); ++i) {
+    if (r.hosts[i].block >= 0) by_block[r.hosts[i].block][r.hosts[i].index] = i;
+  }
+  for (const auto& [block, idxs] : by_block) {
+    (void)block;
+    for (const auto& [start, pos0] : idxs) {
+      (void)pos0;
+      if (start < 0 || start % n != 0) continue;
+      std::vector<size_t> window;
+      bool ok = true;
+      for (int64_t k = 0; k < n; ++k) {
+        auto it = idxs.find(start + k);
+        if (it == idxs.end() || !Fits(r.hosts[it->second], j)) {
+          ok = false;
+          break;
+        }
+        window.push_back(it->second);
+      }
+      if (ok) {
+        placed = window;
+        return true;
+      }
+    }
+  }
+  return false;
+}
+
+// autoscaler.search_assignable_hosts: contiguous window for ICI jobs on
+// a block-annotated fleet; else first-fit over name-sorted hosts,
 // n workers all-or-nothing; fills `placed` with host indices.
 bool SearchAssignable(const Resource& r, const Job& j, int64_t n,
                       std::vector<Host>& scratch, std::vector<size_t>& placed) {
+  if (j.contiguous) {
+    bool any_block = false;
+    for (const Host& h : r.hosts) any_block |= h.block >= 0;
+    // single-host steps must still land ON a block: a DCN-only host
+    // cannot join an ICI slice
+    if (any_block) return ContiguousWindow(r, j, n, placed);
+  }
   scratch = r.hosts;
   placed.clear();
   for (int64_t w = 0; w < n; ++w) {
     bool found = false;
     for (size_t i = 0; i < scratch.size(); ++i) {
-      if (j.cpu_request_milli <= scratch[i].cpu_idle_milli &&
-          j.mem_request_mega <= scratch[i].mem_free_mega &&
-          j.chips_per_worker <= scratch[i].chips_free) {
+      if (Fits(scratch[i], j)) {
         scratch[i].cpu_idle_milli -= j.cpu_request_milli;
         scratch[i].mem_free_mega -= j.mem_request_mega;
         scratch[i].chips_free -= j.chips_per_worker;
@@ -75,7 +125,7 @@ bool SearchAssignable(const Resource& r, const Job& j, int64_t n,
 
 // autoscaler.scale_dry_run: one step for one job; accounts the delta in r.
 int64_t ScaleDryRun(Resource& r, const Job& j, int64_t cur_diff,
-                    double max_load, bool scale_down, Policy policy,
+                    double max_load, bool scale_down,
                     std::vector<Host>& scratch, std::vector<size_t>& placed) {
   const int64_t cpu = j.cpu_request_milli;
   const int64_t mem = j.mem_request_mega;
@@ -102,7 +152,7 @@ int64_t ScaleDryRun(Resource& r, const Job& j, int64_t cur_diff,
   if (scale_down) {
     if (planned > hi) {
       if (planned - 1 > hi) return account(-1, nullptr);
-      int64_t target = FloorLegal(planned - 1, policy, lo, hi);
+      int64_t target = FloorLegal(j, planned - 1, lo, hi);
       return account(target != planned ? target - planned : -1, nullptr);
     }
     const bool chip_over =
@@ -113,7 +163,7 @@ int64_t ScaleDryRun(Resource& r, const Job& j, int64_t cur_diff,
         static_cast<double>(r.cpu_total_milli) * max_load;
     if (chip_over || cpu_over) {
       if (planned > lo) {
-        int64_t target = NextLegal(planned, -1, policy, lo, hi);
+        int64_t target = NextLegal(j, planned, -1, lo, hi);
         return account(target - planned, nullptr);
       }
       return 0;
@@ -123,10 +173,10 @@ int64_t ScaleDryRun(Resource& r, const Job& j, int64_t cur_diff,
 
   // scale-up pass
   if (planned >= hi) {
-    int64_t target = FloorLegal(planned, policy, lo, hi);
+    int64_t target = FloorLegal(j, planned, lo, hi);
     return account(std::min(target, hi) - planned, nullptr);
   }
-  int64_t target = NextLegal(planned, +1, policy, lo, hi);
+  int64_t target = NextLegal(j, planned, +1, lo, hi);
   int64_t step = target - planned;
   if (step <= 0) return 0;
 
@@ -148,7 +198,7 @@ int64_t ScaleDryRun(Resource& r, const Job& j, int64_t cur_diff,
 }  // namespace
 
 std::vector<int64_t> PlanScale(const std::vector<Job>& jobs, Resource& r,
-                               double max_load_desired, Policy policy) {
+                               double max_load_desired) {
   std::vector<int64_t> diff(jobs.size(), 0);
 
   // sorted_jobs: elastic filter; ascending (fulfillment, chips, cpu, mem),
@@ -174,7 +224,7 @@ std::vector<int64_t> PlanScale(const std::vector<Job>& jobs, Resource& r,
     bool no_change = true;
     auto dry = [&](size_t i, bool down) {
       int64_t add = ScaleDryRun(r, jobs[i], diff[i], max_load_desired, down,
-                                policy, scratch, placed);
+                                scratch, placed);
       diff[i] += add;
       if (add != 0) no_change = false;
     };

@@ -13,7 +13,9 @@
 
 namespace edlsched {
 
-enum class Policy : int32_t { kFlexible = 0, kPow2 = 1 };
+// Per-job slice legality (topology.SlicePolicy / SliceShapePolicy):
+// kFlexible = any count; kPow2 = powers of two, optionally capped.
+enum class PolicyKind : int32_t { kFlexible = 0, kPow2 = 1 };
 
 struct Job {
   int64_t min_replicas = 0;
@@ -22,6 +24,9 @@ struct Job {
   int64_t chips_per_worker = 0;
   int64_t cpu_request_milli = 0;
   int64_t mem_request_mega = 0;
+  PolicyKind policy_kind = PolicyKind::kFlexible;
+  int64_t policy_cap = 0;       // max legal count (0 = uncapped)
+  bool contiguous = false;      // multi-host steps need an ICI window
 };
 
 struct Host {
@@ -30,6 +35,12 @@ struct Host {
   int64_t cpu_idle_milli = 0;
   int64_t mem_free_mega = 0;
   int64_t chips_free = 0;
+  // physical slice position (resource.Hosts ici_block/ici_index):
+  // block ids ascend in block-NAME order (the binding guarantees it so
+  // block iteration order matches Python's sorted-name walk); -1 = no
+  // ICI domain (DCN-only host)
+  int64_t block = -1;
+  int64_t index = -1;
 };
 
 struct Resource {
@@ -45,6 +56,6 @@ struct Resource {
 // Plans worker-count deltas for every job (same indexing as `jobs`).
 // Mutates `r` the way the dry run accounts proposed placements.
 std::vector<int64_t> PlanScale(const std::vector<Job>& jobs, Resource& r,
-                               double max_load_desired, Policy policy);
+                               double max_load_desired);
 
 }  // namespace edlsched

@@ -478,3 +478,120 @@ def test_next_legal():
     assert topology.next_legal(4, -1, topology.pow2, 1, 8) == 2
     assert topology.next_legal(8, 1, topology.pow2, 1, 8) == 8  # no legal above
     assert topology.next_legal(3, 1, topology.flexible, 1, 8) == 4
+
+
+# -- slice-topology depth (VERDICT r1 #5) ------------------------------------
+
+
+def make_accel_job(name, accel, chips, lo, hi, parallelism, cpu=1000, mem=1000):
+    j = make_job(name, cpu, mem, chips, lo, hi, parallelism)
+    j.config.spec.accelerator_type = accel
+    return j
+
+
+def _blocked_fleet(n_pods, hosts_per_pod, cpu=16000, mem=32000, chips=4):
+    """A fleet of physical pods: hosts carry ici block + index."""
+    hosts = Hosts()
+    r = ClusterResource()
+    for p in range(n_pods):
+        for i in range(hosts_per_pod):
+            name = f"p{p}h{i}"
+            hosts.cpu_idle_milli[name] = cpu
+            hosts.mem_free_mega[name] = mem
+            hosts.chips_free[name] = chips
+            hosts.ici_block[name] = f"pod{p}"
+            hosts.ici_index[name] = i
+            r.cpu_total_milli += cpu
+            r.mem_total_mega += mem
+            r.chip_total += chips
+    r.hosts = hosts
+    return r
+
+
+def test_family_slice_catalogs():
+    # v5e (2D torus): pow2 host counts capped at the 16x16-chip pod
+    v5e = topology.slice_policy("v5e")
+    assert topology.slice_host_counts("v5e") == [1, 2, 4, 8, 16, 32, 64]
+    assert not v5e(128)  # beyond the largest v5e pod
+    assert not v5e(6)
+    # v4/v5p (3D torus): much larger cap
+    assert topology.slice_policy("v4")(128)
+    assert topology.slice_host_counts("v4")[-1] == 1024
+    # canonical chip-grid names
+    assert topology.topology_name("v5e", 2) == "2x4"
+    assert topology.topology_name("v5e", 8) == "4x8"
+    assert topology.topology_name("v5e", 64) == "16x16"
+    assert topology.topology_name("v5e", 6) == ""
+    assert topology.topology_name("v4", 16) == "4x4x4"
+
+
+def test_policy_for_job_resolution():
+    assert topology.policy_for_job("cpu", 0) is topology.flexible
+    assert topology.policy_for_job("", 4) is topology.flexible
+    assert topology.policy_for_job("v5e", 0) is topology.flexible
+    p = topology.policy_for_job("v5e", 4)
+    assert isinstance(p, topology.SliceShapePolicy)
+    assert p.cap == 64 and p.contiguous
+
+
+def test_v5e_and_dcn_jobs_each_respect_own_legality():
+    """The VERDICT done-criterion: under the "auto" policy a v5e job and
+    a flexible DCN job coexist — the v5e job only takes pow2 counts via
+    contiguous windows, the DCN job takes any count anywhere."""
+    r = _blocked_fleet(n_pods=2, hosts_per_pod=4)
+    # add DCN-only (blockless) cpu hosts for the flexible job
+    for i in range(3):
+        name = f"dcn{i}"
+        r.hosts.cpu_idle_milli[name] = 16000
+        r.hosts.mem_free_mega[name] = 32000
+        r.hosts.chips_free[name] = 0
+        r.cpu_total_milli += 16000
+        r.mem_total_mega += 32000
+
+    tpu = make_accel_job("tpu", "v5e", 4, 1, 8, 1)
+    web = make_accel_job("web", "cpu", 0, 1, 3, 1)
+    diff = scale_all_jobs_dry_run([tpu, web], r, 1.0, "auto")
+    # v5e job lands on a legal slice count (8 hosts available => 8)
+    assert 1 + diff["tpu"] in topology.slice_host_counts("v5e")
+    assert 1 + diff["tpu"] == 8
+    # the flexible job grew without pow2 constraints
+    assert 1 + diff["web"] == 3
+
+
+def test_contiguity_blocks_fragmented_growth():
+    """Free capacity that is NOT an aligned window must not satisfy an
+    ICI job: 4 free hosts spread 2+2 across two pods can't make a
+    4-host slice, but a flexible job takes them happily."""
+    r = _blocked_fleet(n_pods=2, hosts_per_pod=4)
+    # occupy hosts so each pod has exactly 2 free, misaligned: indices
+    # 1,2 free in pod0; 0,3 free in pod1
+    for name in ("p0h0", "p0h3", "p1h1", "p1h2"):
+        r.hosts.chips_free[name] = 0
+    tpu = make_accel_job("tpu", "v5e", 4, 2, 4, 2)
+    tpu.group.parallelism = 2
+    diff = scale_all_jobs_dry_run([tpu], r.copy(), 1.0, "auto")
+    assert diff.get("tpu", 0) == 0  # no aligned window of 4 anywhere
+
+    # pod1 indices 0..3 all free => aligned window exists => growth
+    r2 = _blocked_fleet(n_pods=2, hosts_per_pod=4)
+    for name in ("p0h0", "p0h3"):
+        r2.hosts.chips_free[name] = 0
+    diff2 = scale_all_jobs_dry_run([tpu], r2, 1.0, "auto")
+    assert diff2.get("tpu", 0) == 2  # 2 -> 4 via pod1's aligned window
+
+
+def test_contiguous_window_alignment():
+    """Windows must start at index % n == 0 (sub-slice carving): a run
+    of 2 free hosts at indices 1-2 is contiguous but misaligned."""
+    from edl_tpu.scheduler.autoscaler import search_assignable_hosts
+
+    r = _blocked_fleet(n_pods=1, hosts_per_pod=4)
+    r.hosts.chips_free["p0h0"] = 0
+    r.hosts.chips_free["p0h3"] = 0
+    tpu = make_accel_job("t", "v5e", 4, 0, 4, 0)
+    assert search_assignable_hosts(r, tpu, 2, contiguous=True) is None
+    r.hosts.chips_free["p0h3"] = 4  # indices 2,3 free: aligned window
+    assert search_assignable_hosts(r, tpu, 2, contiguous=True) == [
+        "p0h2",
+        "p0h3",
+    ]

@@ -30,12 +30,15 @@ class _Group:
         self.parallelism = parallelism
 
 
-def _mk_job(name, lo, hi, cur, chips, cpu, mem):
+def _mk_job(name, lo, hi, cur, chips, cpu, mem, accelerator=""):
     job = TrainingJob.from_dict(
         {
             "metadata": {"name": name},
             "spec": {
                 "fault_tolerant": True,
+                **(
+                    {"accelerator_type": accelerator} if accelerator else {}
+                ),
                 "worker": {
                     "min_replicas": lo,
                     "max_replicas": hi,
@@ -52,7 +55,7 @@ def _mk_job(name, lo, hi, cur, chips, cpu, mem):
     return js
 
 
-def _mk_resource(rng, n_hosts):
+def _mk_resource(rng, n_hosts, with_blocks=False):
     hosts = Hosts(
         cpu_idle_milli={}, mem_free_mega={}, chips_free={}
     )
@@ -65,6 +68,9 @@ def _mk_resource(rng, n_hosts):
         hosts.cpu_idle_milli[name] = cpu
         hosts.mem_free_mega[name] = mem
         hosts.chips_free[name] = chips
+        if with_blocks and chips > 0 and rng.rand() < 0.8:
+            hosts.ici_block[name] = f"pod{i // 4}"
+            hosts.ici_index[name] = i % 4
         r.cpu_total_milli += cpu
         r.mem_total_mega += mem
         r.chip_total += chips
@@ -72,11 +78,15 @@ def _mk_resource(rng, n_hosts):
     return r
 
 
-@pytest.mark.parametrize("policy_name", ["flexible", "pow2"])
+@pytest.mark.parametrize("policy_name", ["flexible", "pow2", "auto"])
 @pytest.mark.parametrize("seed", range(20))
 def test_native_plan_matches_python(seed, policy_name):
+    from edl_tpu.scheduler.autoscaler import resolve_policy
+
     rng = np.random.RandomState(seed)
-    policy = topology.POLICIES[policy_name]
+    policy = (
+        "auto" if policy_name == "auto" else topology.POLICIES[policy_name]
+    )
     n_jobs = int(rng.randint(1, 6))
     jobs = []
     for i in range(n_jobs):
@@ -86,9 +96,16 @@ def test_native_plan_matches_python(seed, policy_name):
         chips = int(rng.choice([0, 1, 2, 4]))
         cpu = int(rng.choice([500, 1000, 4000]))
         mem = int(rng.choice([100, 1000, 4000]))
-        jobs.append(_mk_job(f"job{i}", lo, hi, cur, chips, cpu, mem))
+        accel = (
+            str(rng.choice(["v5e", "v4", "cpu", ""]))
+            if policy_name == "auto"
+            else ""
+        )
+        jobs.append(_mk_job(f"job{i}", lo, hi, cur, chips, cpu, mem, accel))
 
-    r = _mk_resource(rng, int(rng.randint(1, 6)))
+    r = _mk_resource(
+        rng, int(rng.randint(1, 8)), with_blocks=(policy_name == "auto")
+    )
     # book the current usage so totals are consistent-ish
     for j in jobs:
         cur = j.group.parallelism
@@ -99,7 +116,9 @@ def test_native_plan_matches_python(seed, policy_name):
     max_load = float(rng.choice([0.8, 0.9, 0.97, 1.0]))
 
     py = scale_all_jobs_dry_run(jobs, r.copy(), max_load, policy)
-    nat = native_sched.plan_native(jobs, r, max_load, policy_name)
+    nat = native_sched.plan_native(
+        jobs, r, max_load, [resolve_policy(policy, j) for j in jobs]
+    )
     assert nat is not None
     # python dict contains elastic candidates it touched; native has all
     for name in nat:
