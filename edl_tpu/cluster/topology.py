@@ -73,6 +73,12 @@ class SliceShapePolicy:
 
     family: str
     cap: int  # max hosts in one slice (largest pod of the family)
+    # Contiguity is enforced PER GROW STEP: each step's new workers must
+    # form one aligned window in one block. Joint contiguity with the
+    # job's EXISTING workers is not enforceable from the capacity-only
+    # census (resource.Hosts carries free capacity, not placements) — a
+    # 2->4 growth can land the new pair in a different pod. Closing that
+    # requires the census to carry per-job host assignments.
     contiguous: bool = True
 
     @property
@@ -107,8 +113,11 @@ def slice_host_counts(family_name: str) -> List[int]:
 
 def topology_name(family_name: str, hosts: int) -> str:
     """Chip-grid name of a slice (e.g. v5e 8 hosts -> "4x8"), for
-    observability; "" when the count is not in the family's catalog."""
+    observability; "" when the count is not in the family's catalog
+    (or the family has no ICI torus at all)."""
     fam = family(family_name)
+    if fam.ici_degree < 4:
+        return ""
     p = slice_policy(family_name)
     if not p(hosts):
         return ""
@@ -135,17 +144,6 @@ def policy_for_job(accelerator_type: str, chips_per_worker: int) -> SlicePolicy:
 
 
 POLICIES: Dict[str, SlicePolicy] = {"flexible": flexible, "pow2": pow2}
-
-
-def policy_name(policy: SlicePolicy) -> str:
-    """Registry name of a built-in policy, or "" for a custom callable
-    (custom policies are Python-only — the native planner can't run them)."""
-    if isinstance(policy, SliceShapePolicy):
-        return policy.name
-    for name, p in POLICIES.items():
-        if p is policy:
-            return name
-    return ""
 
 
 def next_legal(n: int, direction: int, policy: SlicePolicy, lo: int, hi: int) -> int:

@@ -229,10 +229,54 @@ def _llama_workload(cfg: WorkerConfig) -> Workload:
     )
 
 
+def _bert_workload(cfg: WorkerConfig) -> Workload:
+    """BERT-class MLM pretraining under elastic DP with checkpoint
+    reshard (BASELINE config #4: "ERNIE / BERT-base pretraining")."""
+    import jax
+
+    from edl_tpu.models import bert
+
+    mcfg = bert.BertConfig.tiny(vocab=cfg.vocab)
+
+    def batch_fn(start: int, end: int) -> Dict[str, np.ndarray]:
+        r = np.random.RandomState(cfg.seed * 1_000_003 + start + 1)
+        return bert.synthetic_mlm_batch(r, end - start, cfg.seq_len, cfg.vocab)
+
+    return Workload(
+        lambda: bert.init_params(jax.random.PRNGKey(cfg.seed), mcfg),
+        bert.make_loss_fn(mcfg),
+        batch_fn,
+        pspecs=lambda plan: bert.param_pspecs(mcfg, plan),
+    )
+
+
+def _resnet_workload(cfg: WorkerConfig) -> Workload:
+    """ResNet-class image classification under elastic all-reduce DP
+    (BASELINE config #3: "ResNet-50 ImageNet, elastic all-reduce DP")."""
+    import jax
+
+    from edl_tpu.models import resnet
+
+    mcfg = resnet.ResNetConfig.tiny()
+
+    def batch_fn(start: int, end: int) -> Dict[str, np.ndarray]:
+        r = np.random.RandomState(cfg.seed * 1_000_003 + start + 1)
+        return resnet.synthetic_batch(r, end - start)
+
+    return Workload(
+        lambda: resnet.init_params(jax.random.PRNGKey(cfg.seed), mcfg),
+        resnet.make_loss_fn(mcfg),
+        batch_fn,
+        pspecs=lambda plan: resnet.param_pspecs(mcfg, plan),
+    )
+
+
 WORKLOADS: Dict[str, Callable[[WorkerConfig], Workload]] = {
     "linreg": _linreg_workload,
     "ctr": _ctr_workload,
     "llama": _llama_workload,
+    "bert": _bert_workload,
+    "resnet": _resnet_workload,
 }
 
 

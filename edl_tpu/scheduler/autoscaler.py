@@ -271,6 +271,8 @@ def scale_all_jobs_dry_run(
     ``policy`` is a callable applied to every job, or ``"auto"`` for
     per-job resolution from accelerator_type."""
     diff: Dict[str, int] = {}
+    # policies depend only on the static spec: resolve once, not per pass
+    resolved = {j.config.qualified_name: resolve_policy(policy, j) for j in js}
     while True:
         no_change = True
         ordered = sorted_jobs(js, elastic)
@@ -284,7 +286,7 @@ def scale_all_jobs_dry_run(
                 diff.get(name, 0),
                 max_load_desired,
                 is_down,
-                resolve_policy(policy, j),
+                resolved[name],
             )
             log.debug(
                 "dry run scale job",
@@ -482,7 +484,15 @@ class Autoscaler:
                     self.cluster.update_worker_group(group)
                     self.jobs[name].group = group
                     self._last_rescale[name] = time.monotonic()
-                    log.info("scaled job", name=name, target=t)
+                    accel = self.jobs[name].config.spec.accelerator_type
+                    log.info(
+                        "scaled job",
+                        name=name,
+                        target=t,
+                        slice=topology.topology_name(accel, t)
+                        if accel in topology.FAMILIES
+                        else "",
+                    )
                     err = None
                     break
                 except (ConflictError, KeyError) as e:

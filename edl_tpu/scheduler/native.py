@@ -32,14 +32,13 @@ _LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libedl_sched.so")
 _build_lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 
-_SOURCES = ("sched.h", "sched.cc", "capi.cc", "Makefile")
+_SOURCES = ("sched.h", "sched.cc", "capi.cc")
 
 
 def _lib_fresh() -> bool:
     """True when the built .so is newer than every source — the fast
-    path that keeps routine planning from shelling out to make (and
-    keeps concurrent processes from racing a rebuild); a stale .so (old
-    ABI) fails this and triggers a rebuild."""
+    path that keeps routine planning from shelling out to make; a stale
+    .so (old ABI) fails this and triggers a rebuild."""
     if not os.path.exists(_LIB_PATH):
         return False
     so_m = os.path.getmtime(_LIB_PATH)
@@ -53,16 +52,25 @@ def _lib_fresh() -> bool:
 def ensure_native_built() -> bool:
     if _lib_fresh():
         return True
-    with _build_lock:
+    with _build_lock:  # threads of THIS process
         if _lib_fresh():
             return True
         try:
-            subprocess.run(
-                ["make", "-C", _NATIVE_DIR],
-                check=True,
-                capture_output=True,
-                timeout=120,
-            )
+            # cross-PROCESS exclusion: concurrent controllers/workers
+            # after a source change must not race make on one build dir
+            # (a half-linked .so would be dlopen'd by the loser)
+            import fcntl
+
+            os.makedirs(os.path.join(_NATIVE_DIR, "build"), exist_ok=True)
+            with open(os.path.join(_NATIVE_DIR, "build", ".lock"), "w") as lk:
+                fcntl.flock(lk, fcntl.LOCK_EX)
+                if not _lib_fresh():
+                    subprocess.run(
+                        ["make", "-C", _NATIVE_DIR],
+                        check=True,
+                        capture_output=True,
+                        timeout=120,
+                    )
             return True
         except Exception as e:
             log.warn("native scheduler build failed", error=str(e))

@@ -1,0 +1,72 @@
+"""Elastic ResNet training — BASELINE config #3, on the REAL
+multi-process runtime, including a graceful scale-DOWN drain.
+
+Starts at the manifest's min+1 workers, drains one mid-run (the
+autoscaler-squeeze direction of doc/boss_tutorial.md — the departing
+worker keeps stepping until rank 0 publishes the reshard, then exits 0),
+and finishes on the smaller mesh with state carried in place.
+
+Run (hardware-free): python examples/resnet/train.py
+"""
+
+import argparse
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--samples", type=int, default=1536)
+    ap.add_argument("--per-worker-batch", type=int, default=16)
+    ap.add_argument("--work-dir", default="")
+    args = ap.parse_args()
+
+    from edl_tpu.api.job import TrainingJob
+    from edl_tpu.api.parser import JobParser
+    from edl_tpu.runtime.launcher import ProcessJobLauncher
+
+    job = TrainingJob.from_yaml_file(
+        os.path.join(os.path.dirname(__file__), "job.yaml")
+    )
+    JobParser().validate(job)
+    wd = args.work_dir or tempfile.mkdtemp(prefix="resnet_elastic_")
+    start = job.spec.worker.min_replicas + 1
+
+    with ProcessJobLauncher(
+        job=job.name,
+        model="resnet",
+        min_workers=job.spec.worker.min_replicas,
+        max_workers=job.spec.worker.max_replicas,
+        n_samples=args.samples,
+        passes=job.spec.passes,
+        per_device_batch=args.per_worker_batch,
+        step_sleep_s=0.05,
+        work_dir=wd,
+    ) as launcher:
+        launcher.start(start)
+        print(f"submitted {job.name}: {start} workers (elastic "
+              f"{job.spec.worker.min_replicas}..{job.spec.worker.max_replicas})")
+        launcher.wait_progress(3, timeout_s=240)
+        print(f"draining down to {start - 1} workers mid-run ...")
+        launcher.scale_to(start - 1)
+        rcs = launcher.wait(timeout_s=600)
+        # the drained worker also exits 0: graceful departure
+        assert all(rc == 0 for rc in rcs.values()), rcs
+        first = float(launcher.kv("loss_first"))
+        last = float(launcher.kv("loss_last"))
+        reshards = int(launcher.kv("reshards") or "0")
+        print(
+            f"done: phase={launcher.kv('phase')} steps={launcher.progress()} "
+            f"loss {first:.4f} -> {last:.4f} reshards={reshards}"
+        )
+        assert launcher.kv("phase") == "succeeded"
+        assert reshards >= 1
+        assert last < first
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -103,3 +103,33 @@ def test_recognize_digits_static_shards(monkeypatch, capsys, cpu_devices):
     out = capsys.readouterr().out
     assert "phase=succeeded" in out
     assert "fixed 4 workers" in out
+
+
+def test_bert_elastic_pretrain(monkeypatch, capsys):
+    """BASELINE config #4: BERT-class elastic DP with checkpoint
+    reshard, through the real multi-process runtime with one scale-up."""
+    assert (
+        _run_example(
+            monkeypatch,
+            "bert/train.py",
+            ["--samples", "512", "--seq-len", "24", "--step-sleep", "0.3"],
+        )
+        == 0
+    )
+    out = capsys.readouterr().out
+    assert "phase=succeeded" in out and "reshards=1" in out
+
+
+def test_resnet_elastic_train(monkeypatch, capsys):
+    """BASELINE config #3: ResNet-class elastic all-reduce DP with a
+    graceful mid-run scale-down drain."""
+    assert (
+        _run_example(
+            monkeypatch,
+            "resnet/train.py",
+            ["--samples", "1024"],
+        )
+        == 0
+    )
+    out = capsys.readouterr().out
+    assert "phase=succeeded" in out and "reshards=1" in out
