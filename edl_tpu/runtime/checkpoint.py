@@ -75,6 +75,37 @@ def staged_reshard(
     )
 
 
+def state_nbytes(state) -> int:
+    """Total bytes of a TrainState (params + optimizer + step)."""
+    return sum(
+        int(getattr(x, "nbytes", 0)) for x in jax.tree_util.tree_leaves(state)
+    )
+
+
+def host_fallback_stall_model(
+    state_bytes: int, hosts_after: int, host_bw_bytes_s: float
+) -> float:
+    """Worst-case host-staged reshard stall, in seconds.
+
+    The fallback moves state through host RAM when no device path
+    exists (disjoint device sets — e.g. a slice swap). Each surviving
+    host must ingest its share of the FULL post-reshard state,
+    ``state_bytes / hosts_after``, through its own host<->device link;
+    with the overlapped down/up pipeline (sharding.stream_reshard) the
+    stall is ~max(d2h, h2d) ≈ one direction's bytes over the link
+    bandwidth. Shrinks are the worst case: fewer hosts absorb the same
+    total state (the v5e-64 → v5e-4 shrink in BASELINE.md concentrates
+    16x the per-host bytes). ``host_bw_bytes_s`` is the measured
+    single-host streaming bandwidth — bench.py derives it from the
+    flagship staged-reshard measurement and evaluates this model as
+    ``stall_model_8b_1host_s``; doc/reshard_stall.md carries the full
+    derivation and the <30 s budget check.
+    """
+    if hosts_after <= 0 or host_bw_bytes_s <= 0:
+        raise ValueError("hosts_after and host_bw_bytes_s must be positive")
+    return (state_bytes / hosts_after) / host_bw_bytes_s
+
+
 # -- disk format -------------------------------------------------------------
 
 

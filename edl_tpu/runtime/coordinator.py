@@ -43,12 +43,34 @@ _BIN_PATH = os.path.join(_NATIVE_DIR, "build", "edl-coordinator")
 _build_lock = threading.Lock()
 
 
+_COORD_SOURCES = (
+    "coordinator.h",
+    "coordinator.cc",
+    "capi.cc",
+    "server_main.cc",
+    "Makefile",
+)
+
+
+def _coord_fresh() -> bool:
+    """Built artifacts newer than every source (incl. the Makefile, so
+    flag changes rebuild) — same freshness policy as scheduler/native."""
+    if not (os.path.exists(_LIB_PATH) and os.path.exists(_BIN_PATH)):
+        return False
+    built = min(os.path.getmtime(_LIB_PATH), os.path.getmtime(_BIN_PATH))
+    for s in _COORD_SOURCES:
+        p = os.path.join(_NATIVE_DIR, s)
+        if os.path.exists(p) and os.path.getmtime(p) > built:
+            return False
+    return True
+
+
 def ensure_native_built() -> bool:
     """Build the native lib/binary on demand; False if no toolchain."""
-    if os.path.exists(_LIB_PATH) and os.path.exists(_BIN_PATH):
+    if _coord_fresh():
         return True
     with _build_lock:
-        if os.path.exists(_LIB_PATH) and os.path.exists(_BIN_PATH):
+        if _coord_fresh():
             return True
         try:
             subprocess.run(

@@ -34,7 +34,13 @@ import optax
 from edl_tpu.api.job import MeshSpec
 from edl_tpu.parallel.mesh import MeshPlan
 from edl_tpu.runtime import checkpoint as ckpt
-from edl_tpu.train.trainer import TrainState, global_batch, make_train_step, shard_state
+from edl_tpu.train.trainer import (
+    LocalSyncStepper,
+    TrainState,
+    global_batch,
+    make_train_step,
+    shard_state,
+)
 from edl_tpu.utils import tracing
 from edl_tpu.utils.logging import Timer, kv_logger
 
@@ -60,6 +66,10 @@ class ReshardEvent:
     stall_s: float  # snapshot + remesh + reshard (the traffic-stopping window)
     recompile_s: float  # first-step compile on the new mesh (overlappable)
     step: int
+    # True when the direct device-to-device move failed and the reshard
+    # went through host-RAM staging — the slow path whose cost scales
+    # with per-host state bytes (see doc/reshard_stall.md for the bound)
+    fallback: bool = False
 
 
 @dataclass
@@ -104,6 +114,7 @@ class ElasticTrainer:
         on_reshard: Optional[Callable[[ReshardEvent], None]] = None,
         checkpoint_dir: Optional[str] = None,
         checkpoint_every_steps: int = 0,
+        sync_every: int = 1,
     ):
         self.loss_fn = loss_fn
         self.tx = tx
@@ -118,6 +129,11 @@ class ElasticTrainer:
         # cadence, example/ctr/ctr/train.py:169-180, made first-class)
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_every_steps = checkpoint_every_steps
+        # delayed-sync DP (local SGD): K local steps per dp group between
+        # cross-group averages — the TPU analog of the reference's
+        # --async_mode (example/ctr/ctr/train.py:75-79). 1 = fully sync.
+        self.sync_every = max(int(sync_every), 1)
+        self._stepper: Optional[LocalSyncStepper] = None
 
         self.n_workers = 0
         self.mesh = None
@@ -143,6 +159,8 @@ class ElasticTrainer:
         self._build(n_workers)
         host = TrainState.create(params, self.tx)
         self.state = shard_state(host, self.plan, self.mesh, self._pspecs)
+        if self._stepper is not None:
+            self.state = self._stepper.localize(self.state)
         self._host_step = 0
         log.info(
             "elastic trainer started",
@@ -159,6 +177,8 @@ class ElasticTrainer:
         template = TrainState.create(params, self.tx)
         host = ckpt.load(checkpoint_path, template)
         self.state = ckpt.restore(host, self.plan, self.mesh, self._pspecs)
+        if self._stepper is not None:
+            self.state = self._stepper.localize(self.state)
         self._host_step = int(np.asarray(host.step))
         log.info(
             "elastic trainer resumed",
@@ -182,8 +202,11 @@ class ElasticTrainer:
         path = os.path.join(self.checkpoint_dir, f"step-{step}")
         if os.path.exists(os.path.join(path, "state.npz")):
             return None  # already saved at this step
+        # delayed-sync mode checkpoints the group AVERAGE (the consensus
+        # model), not one group's drifted copy
+        to_save = self.merged_state
         with tracing.span("checkpoint.save", step=step):
-            ckpt.save(path, self.state, {"n_workers": self.n_workers})
+            ckpt.save(path, to_save, {"n_workers": self.n_workers})
         return path
 
     def _build(self, n_workers: int) -> None:
@@ -204,6 +227,19 @@ class ElasticTrainer:
         self._step_fn = make_train_step(
             self.loss_fn, self.tx, self.plan, self.mesh, self._pspecs
         )
+        self._stepper = (
+            LocalSyncStepper(self.loss_fn, self.tx, self.plan, self.mesh)
+            if self.sync_every > 1
+            else None
+        )
+
+    @property
+    def merged_state(self) -> Optional[TrainState]:
+        """The consensus TrainState: in delayed-sync mode, the group
+        average; otherwise the live state itself. Use for eval/export."""
+        if self.state is not None and self._stepper is not None:
+            return self._stepper.merge(self.state)
+        return self.state
 
     # -- elastic surface ---------------------------------------------------
 
@@ -244,11 +280,15 @@ class ElasticTrainer:
             return
         prev = self.n_workers
         step_at = self._host_step
+        used_fallback = False
         log.info("reshard begin", from_workers=prev, to_workers=target)
         with Timer() as stall, tracing.span(
             "reshard", from_workers=prev, to_workers=target, step=step_at
         ):
-            old_state = self.state
+            # delayed-sync groups are collapsed to their average before
+            # the move: the new dp width means a new group count, and the
+            # merge is the same one all-reduce a sync boundary costs
+            old_state = self.merged_state
             with tracing.span("reshard.build_mesh", to_workers=target):
                 self._build(target)  # new mesh over new device set
             try:
@@ -261,12 +301,15 @@ class ElasticTrainer:
             except (ValueError, TypeError, RuntimeError) as e:
                 # transfer-layer failures fall back to host-RAM staging;
                 # deterministic spec bugs will fail again here and surface
+                used_fallback = True
                 log.warn("device reshard failed; staging via host", error=str(e))
                 with tracing.span("reshard.host_staging"):
                     # overlapped down/up pipeline: ~max(d2h, h2d), not sum
                     self.state = ckpt.staged_reshard(
                         old_state, self.plan, self.mesh, self._pspecs
                     )
+            if self._stepper is not None:
+                self.state = self._stepper.localize(self.state)
             del old_state
         ev = ReshardEvent(
             from_workers=prev,
@@ -274,6 +317,7 @@ class ElasticTrainer:
             stall_s=stall.elapsed,
             recompile_s=0.0,  # filled after the first step on the new mesh
             step=step_at,
+            fallback=used_fallback,
         )
         self.report.reshards.append(ev)
         log.info(
@@ -281,6 +325,7 @@ class ElasticTrainer:
             from_workers=prev,
             to_workers=target,
             stall_s=round(stall.elapsed, 4),
+            fallback=used_fallback,
         )
         if self.on_reshard:
             self.on_reshard(ev)
@@ -301,7 +346,12 @@ class ElasticTrainer:
                 and self.report.reshards[-1].recompile_s == 0.0
             )
             tc = time.perf_counter()
-            self.state, metrics = self._step_fn(self.state, dev_batch)
+            if self._stepper is not None:
+                self.state, metrics = self._stepper.step(self.state, dev_batch)
+                if (self._host_step + 1) % self.sync_every == 0:
+                    self.state = self._stepper.sync(self.state)
+            else:
+                self.state, metrics = self._step_fn(self.state, dev_batch)
             if first_on_mesh:
                 jax.block_until_ready(metrics["loss"])
                 recompile_s = time.perf_counter() - tc

@@ -147,10 +147,12 @@ class ProcessJobLauncher:
     def live_workers(self) -> List[WorkerProc]:
         return [w for w in self.workers if w.proc.poll() is None]
 
-    def scale_to(self, n: int) -> None:
+    def scale_to(self, n: int) -> List[str]:
         """Reference semantics: retargeting Parallelism adds pods or
-        removes the newest ones (graceful SIGTERM drain)."""
+        removes the newest ones (graceful SIGTERM drain). Returns the
+        worker ids that were sent SIGTERM (empty on scale-up)."""
         live = self.live_workers()
+        terminated: List[str] = []
         if n > len(live):
             for _ in range(n - len(live)):
                 self.spawn()
@@ -158,6 +160,8 @@ class ProcessJobLauncher:
             for w in sorted(live, key=lambda w: w.worker_id)[n:]:
                 log.info("terminating worker", worker=w.worker_id)
                 w.proc.send_signal(signal.SIGTERM)
+                terminated.append(w.worker_id)
+        return terminated
 
     def kill(self, worker_id: str, sig: int = signal.SIGKILL) -> None:
         """Fault injection: hard-kill a worker (no graceful drain)."""

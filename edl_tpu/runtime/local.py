@@ -38,6 +38,7 @@ class LocalJobRunner:
         per_chip_batch: int = 32,
         param_pspecs=None,
         devices=None,
+        sync_every: int = 1,
     ):
         self.controller = controller
         self.job = job
@@ -54,6 +55,7 @@ class LocalJobRunner:
             param_pspecs=param_pspecs,
             devices=devices,
             on_reshard=self._reshard_done,
+            sync_every=sync_every,
         )
         # autoscaler retarget -> in-place reshard at next step boundary
         self._attached = False

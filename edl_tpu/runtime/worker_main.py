@@ -998,6 +998,14 @@ class ElasticWorker:
 def main(argv=None) -> int:
     import argparse
 
+    # provisional shield: a scale-down SIGTERM that lands before the
+    # worker has joined the job (registration happens inside run()) is
+    # a clean no-op departure — exit 0 without touching membership.
+    # The drain handler replaces this below. The only remaining window
+    # is interpreter startup itself (same exposure as a pod deleted
+    # during container start in the reference).
+    signal.signal(signal.SIGTERM, lambda *a: sys.exit(0))
+
     # configuration comes from the EDL_* env contract injected by the
     # controller (api/parser.py pod_env); argv exists for --help only
     argparse.ArgumentParser(

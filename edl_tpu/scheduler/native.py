@@ -32,7 +32,7 @@ _LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libedl_sched.so")
 _build_lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 
-_SOURCES = ("sched.h", "sched.cc", "capi.cc")
+_SOURCES = ("sched.h", "sched.cc", "capi.cc", "Makefile")
 
 
 def _lib_fresh() -> bool:

@@ -205,6 +205,154 @@ def make_train_multistep(
     return multi
 
 
+class LocalSyncStepper:
+    """K-step delayed-sync data parallelism (local SGD).
+
+    The TPU translation of the reference's relaxed-consistency pserver
+    mode (``--async_mode``, reference example/ctr/ctr/train.py:75-79):
+    instead of trainers pushing gradients to pservers whenever they
+    finish a step, each dp group keeps a PRIVATE copy of params and
+    optimizer moments, takes K purely-local updates with zero cross-group
+    traffic, and every K steps the copies are averaged (one all-reduce
+    over the dp axis). With dp groups split across DCN this removes the
+    per-step DCN collective entirely — the asynchrony budget K is the
+    staleness bound, where the reference's pserver gave no bound at all.
+
+    State layout: params/opt-state leaves carry a leading ``dp``-sized
+    group axis sharded ``P("dp")``, so the local step is a ``vmap`` with
+    no collectives (XLA sees only elementwise-along-sharded-axis work)
+    and the sync is one mean over the sharded axis. ``step`` stays a
+    replicated scalar. Restricted to dp-only meshes — the reference
+    feature is pserver DP; sharded-param layouts (fsdp/tp) have no
+    "private copy" to let drift.
+
+    Usage::
+
+        stepper = LocalSyncStepper(loss_fn, tx, plan, mesh)
+        lstate = stepper.localize(state)          # replicated -> grouped
+        for i in range(n):
+            lstate, m = stepper.step(lstate, batch)   # no dp collective
+            if (i + 1) % K == 0:
+                lstate = stepper.sync(lstate)         # one all-reduce
+        state = stepper.merge(lstate)             # grouped -> replicated
+    """
+
+    def __init__(
+        self,
+        loss_fn: Callable[[Any, Any], jnp.ndarray],
+        tx: optax.GradientTransformation,
+        plan: MeshPlan,
+        mesh: Mesh,
+        sync_moments: bool = True,
+    ):
+        busy = [
+            a for a in ("pp", "fsdp", "sp", "ep", "tp") if plan.axis_size(a) > 1
+        ]
+        if busy:
+            raise ValueError(
+                f"local-sync (delayed-sync DP) requires a dp-only mesh; "
+                f"axes {busy} shard parameters, which leaves no private "
+                f"per-group copy to run ahead on"
+            )
+        self.plan = plan
+        self.mesh = mesh
+        self.dp = plan.axis_size("dp")
+        self.sync_moments = sync_moments
+        dp = self.dp
+
+        grouped = TrainState(
+            step=NamedSharding(mesh, P()),
+            params=NamedSharding(mesh, P("dp")),
+            opt_state=NamedSharding(mesh, P("dp")),
+        )
+        replicated = NamedSharding(mesh, P())
+        batch_sh = plan.batch_sharding(mesh)
+
+        def _localize(state: TrainState) -> TrainState:
+            bc = lambda x: jnp.broadcast_to(x[None], (dp,) + jnp.shape(x))
+            return TrainState(
+                step=state.step,
+                params=jax.tree_util.tree_map(bc, state.params),
+                opt_state=jax.tree_util.tree_map(bc, state.opt_state),
+            )
+
+        def _avg(x):
+            if jnp.issubdtype(x.dtype, jnp.floating):
+                return jnp.mean(x, axis=0, dtype=jnp.float32).astype(x.dtype)
+            return x[0]  # int leaves (adam counts) are identical per group
+
+        def _merge(state: TrainState) -> TrainState:
+            return TrainState(
+                step=state.step,
+                params=jax.tree_util.tree_map(_avg, state.params),
+                opt_state=jax.tree_util.tree_map(_avg, state.opt_state),
+            )
+
+        def _sync(state: TrainState) -> TrainState:
+            keep = lambda x: jnp.broadcast_to(
+                _avg(x)[None], x.shape
+            ) if jnp.issubdtype(x.dtype, jnp.floating) else x
+            return TrainState(
+                step=state.step,
+                params=jax.tree_util.tree_map(keep, state.params),
+                opt_state=jax.tree_util.tree_map(keep, state.opt_state)
+                if sync_moments
+                else state.opt_state,
+            )
+
+        def _lstep(state: TrainState, batch):
+            # [B, ...] -> [dp, B/dp, ...]; the global batch's dp shards
+            # become the per-group local batches (layout-preserving).
+            bt = jax.tree_util.tree_map(
+                lambda x: x.reshape((dp, x.shape[0] // dp) + x.shape[1:]), batch
+            )
+
+            def upd(p, o, b):
+                st = TrainState(step=state.step, params=p, opt_state=o)
+                new, loss = _apply_update(loss_fn, tx, st, b)
+                return new.params, new.opt_state, loss
+
+            params, opt, losses = jax.vmap(upd)(state.params, state.opt_state, bt)
+            new = TrainState(step=state.step + 1, params=params, opt_state=opt)
+            return new, {"loss": jnp.mean(losses)}
+
+        self._localize = jax.jit(
+            _localize, in_shardings=(replicated,), out_shardings=grouped
+        )
+        self._merge = jax.jit(
+            _merge, in_shardings=(grouped,), out_shardings=replicated,
+        )
+        self._sync = jax.jit(
+            _sync,
+            in_shardings=(grouped,),
+            out_shardings=grouped,
+            donate_argnums=(0,),
+        )
+        self._step = jax.jit(
+            _lstep,
+            in_shardings=(grouped, batch_sh),
+            out_shardings=(grouped, {"loss": replicated}),
+            donate_argnums=(0,),
+        )
+
+    def localize(self, state: TrainState) -> TrainState:
+        """Replicated TrainState -> grouped form (leading dp axis)."""
+        return self._localize(state)
+
+    def merge(self, lstate: TrainState) -> TrainState:
+        """Grouped form -> replicated TrainState (group average)."""
+        return self._merge(lstate)
+
+    def sync(self, lstate: TrainState) -> TrainState:
+        """Average params (and moments) across groups — the one
+        all-reduce of a K-step round."""
+        return self._sync(lstate)
+
+    def step(self, lstate: TrainState, batch):
+        """One local step on every group — no cross-group collectives."""
+        return self._step(lstate, batch)
+
+
 def stack_batches(batches, plan: MeshPlan, mesh: Mesh):
     """Stack host batches along a new leading steps axis and place them
     for :func:`make_train_multistep`."""

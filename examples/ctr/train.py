@@ -40,6 +40,11 @@ def main() -> int:
                     "synthetic rows when absent (the reference pre-bakes "
                     "RecordIO shards into the job image)")
     ap.add_argument("--samples", type=int, default=65536)
+    ap.add_argument("--sync-every", type=int, default=1,
+                    help="delayed-sync DP: K local steps per dp group "
+                    "between cross-group averages (the TPU analog of the "
+                    "reference's --async_mode, ctr/train.py:75-79); 1 = "
+                    "fully synchronous")
     args = ap.parse_args()
 
     force_virtual_cpu(args.devices)
@@ -92,6 +97,7 @@ def main() -> int:
         optax.adam(1e-3),
         ctr.init_params(jax.random.PRNGKey(0), vocab=args.vocab),
         per_chip_batch=args.batch,
+        sync_every=args.sync_every,
     )
 
     third = max(args.steps // 3, 1)

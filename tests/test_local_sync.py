@@ -1,0 +1,167 @@
+"""Delayed-sync (local SGD) data parallelism — the TPU translation of
+the reference's relaxed-consistency pserver mode (``--async_mode``,
+reference example/ctr/ctr/train.py:75-79).
+
+Covers: exact equivalence with synchronous DP at K=1 under SGD,
+convergence parity at K=4 on the CTR workload (the VERDICT acceptance
+bar), elastic reshard mid-run under delayed sync, checkpointing the
+consensus state, and the dp-only restriction.
+"""
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from edl_tpu.api.job import MeshSpec
+from edl_tpu.models import ctr
+from edl_tpu.parallel.mesh import MeshPlan
+from edl_tpu.parallel import sharding as shd
+from edl_tpu.runtime.elastic import ElasticTrainer
+from edl_tpu.train.trainer import (
+    LocalSyncStepper,
+    TrainState,
+    global_batch,
+    make_train_step,
+    shard_state,
+)
+
+
+def _ctr_setup(plan, vocab=1024, lr=1e-2, opt="adam"):
+    mesh = plan.build()
+    params = ctr.init_params(jax.random.PRNGKey(1), vocab=vocab, emb=8)
+    tx = optax.sgd(lr) if opt == "sgd" else optax.adam(lr)
+    state = shard_state(TrainState.create(params, tx), plan, mesh)
+    return mesh, tx, state
+
+
+def test_k1_sgd_matches_sync_dp(cpu_devices):
+    """One local SGD step then a group average IS the synchronous DP
+    update (linearity of the SGD rule): p - lr*mean_i(g_i)."""
+    plan = MeshPlan.data_parallel(4)
+    mesh, tx, state0 = _ctr_setup(plan, opt="sgd")
+
+    rng = np.random.RandomState(0)
+    batches = [ctr.synthetic_batch(rng, 64, vocab=1024) for _ in range(4)]
+
+    sync_step = make_train_step(ctr.loss_fn, tx, plan, mesh, donate=False)
+    s_sync = state0
+    for b in batches:
+        s_sync, _ = sync_step(s_sync, global_batch(b, plan, mesh))
+
+    stepper = LocalSyncStepper(ctr.loss_fn, tx, plan, mesh)
+    s_loc = stepper.localize(state0)
+    for b in batches:
+        s_loc, _ = stepper.step(s_loc, global_batch(b, plan, mesh))
+        s_loc = stepper.sync(s_loc)  # K=1: average after every step
+    s_loc = stepper.merge(s_loc)
+
+    a = shd.to_host(s_sync.params)
+    b_ = shd.to_host(s_loc.params)
+    for x, y in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b_)):
+        np.testing.assert_allclose(x, y, rtol=2e-5, atol=1e-6)
+    assert int(np.asarray(s_loc.step)) == 4
+
+
+def test_convergence_parity_k4_ctr(cpu_devices):
+    """K=4 delayed sync trains CTR to parity with synchronous DP —
+    the VERDICT #8 acceptance criterion."""
+
+    def run(sync_every):
+        tr = ElasticTrainer(
+            ctr.loss_fn,
+            optax.adam(1e-2),
+            mesh_spec=MeshSpec(dp=4),
+            per_chip_batch=64,
+            sync_every=sync_every,
+        )
+        tr.pool = tr.pool[:4]
+        tr.start(ctr.init_params(jax.random.PRNGKey(2), vocab=2048, emb=8), 4)
+        rng = np.random.RandomState(3)
+        rep = tr.train_steps(
+            lambda bs: ctr.synthetic_batch(rng, bs, vocab=2048), 96
+        )
+        return rep.losses
+
+    sync_losses = run(1)
+    local_losses = run(4)
+    # both learn: final-quarter mean loss well below the start
+    s_end = np.mean(sync_losses[-12:])
+    l_end = np.mean(local_losses[-12:])
+    assert s_end < sync_losses[0] * 0.8
+    assert l_end < local_losses[0] * 0.8
+    # parity: delayed sync within 15% of the synchronous endpoint
+    assert l_end < s_end * 1.15, (s_end, l_end)
+
+
+def test_reshard_and_checkpoint_under_delayed_sync(cpu_devices, tmp_path):
+    """A rescale mid-round merges the groups, reshards, and re-forms
+    them on the new dp width; checkpoints hold the consensus average."""
+    tr = ElasticTrainer(
+        ctr.loss_fn,
+        optax.adam(1e-2),
+        mesh_spec=MeshSpec(),
+        per_chip_batch=32,
+        sync_every=3,
+        checkpoint_dir=str(tmp_path),
+        checkpoint_every_steps=5,
+    )
+    tr.start(ctr.init_params(jax.random.PRNGKey(0), vocab=512, emb=8), 2)
+    rng = np.random.RandomState(1)
+    data = lambda bs: ctr.synthetic_batch(rng, bs, vocab=512)
+
+    tr.train_steps(data, 4)
+    tr.request_rescale(8)
+    rep = tr.train_steps(data, 8)
+
+    assert [(e.from_workers, e.to_workers) for e in rep.reshards] == [(2, 8)]
+    assert tr.n_workers == 8
+    assert int(np.asarray(tr.state.step)) == 12
+    # checkpoint written at step 5 or 10 contains a MERGED (replicated)
+    # state: leaves carry model shapes, no leading group axis
+    from edl_tpu.runtime import checkpoint as ckpt
+
+    paths = sorted(tmp_path.iterdir())
+    assert paths, "no checkpoint written"
+    template = TrainState.create(
+        ctr.init_params(jax.random.PRNGKey(0), vocab=512, emb=8),
+        optax.adam(1e-2),
+    )
+    loaded = ckpt.load(str(paths[0]), template)
+    emb_shape = np.asarray(
+        jax.tree_util.tree_leaves(loaded.params)[0]
+    ).shape
+    host_template_shape = np.asarray(
+        jax.tree_util.tree_leaves(template.params)[0]
+    ).shape
+    assert emb_shape == host_template_shape
+    # loss decreased over the run
+    assert np.mean(rep.losses[-3:]) < rep.losses[0]
+
+
+def test_merged_state_property(cpu_devices):
+    tr = ElasticTrainer(
+        ctr.loss_fn,
+        optax.adam(1e-2),
+        mesh_spec=MeshSpec(),
+        per_chip_batch=32,
+        sync_every=2,
+    )
+    tr.start(ctr.init_params(jax.random.PRNGKey(0), vocab=256, emb=8), 4)
+    rng = np.random.RandomState(1)
+    tr.train_steps(lambda bs: ctr.synthetic_batch(rng, bs, vocab=256), 3)
+    merged = tr.merged_state
+    live_emb = tr.state.params["embedding"]
+    merged_emb = merged.params["embedding"]
+    # live state is grouped (leading dp axis), merged is model-shaped
+    assert live_emb.ndim == merged_emb.ndim + 1
+    assert live_emb.shape[1:] == merged_emb.shape
+
+
+def test_stepper_rejects_param_sharded_mesh(cpu_devices):
+    plan = MeshPlan.fsdp_only(4)
+    mesh = plan.build()
+    with pytest.raises(ValueError, match="dp-only"):
+        LocalSyncStepper(ctr.loss_fn, optax.adam(1e-3), plan, mesh)
