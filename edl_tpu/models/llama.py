@@ -22,6 +22,7 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec as P
 
 from edl_tpu.parallel.mesh import MeshPlan
@@ -44,6 +45,19 @@ class LlamaConfig:
     # FLOPs for O(L·B·T·d) instead of O(L·B·T·(d+ff+heads)) activation
     # HBM — what lets non-toy configs train on one chip
     remat: bool = False
+    # what the remat saves besides layer inputs — the FLOPs/HBM dial:
+    #   "full": recompute everything (min memory, +2 fwd-matmul units
+    #           of the 6-unit fwd+bwd budget)
+    #   "attn": also save the flash-attention output + logsumexp —
+    #           the backward reuses them instead of re-running the
+    #           (VPU-bound) softmax kernel; q/k/v reprojections stay
+    #           cheap matmul recomputes. ~2·d bf16 bytes/token/layer.
+    #   "mlp":  also save the SwiGLU gate/up products [B,T,d_ff] —
+    #           skips recomputing w1/w3, half the layer's recompute,
+    #           for 2·d_ff bf16 bytes/token/layer of HBM
+    #   "dots": save every weight-matmul output (near-zero recompute,
+    #           most HBM — jax dots_with_no_batch_dims_saveable)
+    remat_policy: str = "full"
 
     @property
     def head_dim(self) -> int:
@@ -121,12 +135,10 @@ def param_pspecs(cfg: LlamaConfig, plan: MeshPlan) -> Dict:
         cfg.vocab,
     )
 
+    from edl_tpu.parallel.sharding import fit_pspec
+
     def fit(shape, *axes):
-        parts = []
-        for dim, ax in zip(shape, axes):
-            ok = ax is not None and dim % plan.axis_size(ax) == 0
-            parts.append(ax if ok else None)
-        return P(*parts)
+        return fit_pspec(plan, shape, *axes)
 
     return {
         "embed": fit((V, d), tp, fs),
@@ -198,8 +210,8 @@ def _layer(cfg: LlamaConfig, x: jnp.ndarray, lp: Dict) -> jnp.ndarray:
     x = x + o @ lp["wo"].astype(dt)
     # mlp block (SwiGLU)
     m = _rmsnorm(x, lp["ln2"], cfg.norm_eps)
-    gate = jax.nn.silu(m @ lp["w1"].astype(dt))
-    up = m @ lp["w3"].astype(dt)
+    gate = checkpoint_name(jax.nn.silu(m @ lp["w1"].astype(dt)), "mlp_gate")
+    up = checkpoint_name(m @ lp["w3"].astype(dt), "mlp_up")
     return x + (gate * up) @ lp["w2"].astype(dt)
 
 
@@ -211,7 +223,28 @@ def forward(params: Dict, tokens: jnp.ndarray, cfg: LlamaConfig) -> jnp.ndarray:
         return _layer(cfg, carry, lp), None
 
     if cfg.remat:
-        body = jax.checkpoint(body)
+        if cfg.remat_policy == "mlp":
+            policy = jax.checkpoint_policies.save_only_these_names(
+                "mlp_gate", "mlp_up"
+            )
+        elif cfg.remat_policy == "attn":
+            if not cfg.use_flash:
+                raise ValueError(
+                    'remat_policy="attn" saves the flash kernel\'s named '
+                    "residuals; without use_flash there is nothing to "
+                    "save and the policy would silently degrade to full "
+                    "rematerialization"
+                )
+            policy = jax.checkpoint_policies.save_only_these_names(
+                "flash_out", "flash_lse"
+            )
+        elif cfg.remat_policy == "dots":
+            policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        elif cfg.remat_policy == "full":
+            policy = None
+        else:
+            raise ValueError(f"unknown remat_policy {cfg.remat_policy!r}")
+        body = jax.checkpoint(body, policy=policy)
     x, _ = jax.lax.scan(body, x, params["layers"])
     x = _rmsnorm(x, params["ln_f"], cfg.norm_eps)
     return (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)

@@ -21,12 +21,18 @@ layout, the Mosaic minimum f32 tile); the backward runs two pallas
 sweeps, dQ (kv innermost) and dK/dV (q innermost, per-query-head then
 group-summed for GQA), with delta = rowsum(dO*O) precomputed in XLA.
 
-Measured on v5e (fenced timing): forward T=2048 d=128 h=16 — 8.5 ms vs
-9.2 ms XLA fused attention; T=16384 causal — 15.9 ms vs 29.2 ms XLA.
-Forward+backward (b=4 T=2048 h=16 kv=8): 15.4 ms vs 20.3 ms XLA;
-T=8192: 23.9 ms vs 50.2 ms XLA (causal block skipping compounds at
-long context). Falls back to interpret mode off-TPU (same code path,
-test-coverable on CPU).
+The kernel is VPU-bound at d=128 (softmax elementwise + cross-lane
+reductions dwarf the MXU matmuls), so the causal mask's iota/compare/
+select runs ONLY on diagonal-crossing blocks — fully-live blocks take
+a mask-free code path (two ``pl.when`` branches per kernel).
+
+Measured on v5e (fenced timing, 16 chained calls amortizing dispatch):
+forward b=16 T=2048 h=16 d=128 — 8.8 ms/call (31 TF/s); fwd+bwd
+26.4 ms/call. The jax.experimental reference pallas TPU kernel on the
+same chip/shape: 27.1 ms forward, 40.8 ms fwd+bwd — this kernel is
+~3x faster forward. In-model effect of the diagonal-skip + (512,1024)
+blocks: flagship MFU 0.502 -> 0.524. Falls back to interpret mode
+off-TPU (same code path, test-coverable on CPU).
 """
 
 from __future__ import annotations
@@ -36,6 +42,7 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -105,16 +112,20 @@ def _flash_kernel(
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    # causal: blocks entirely above the diagonal contribute nothing
+    # causal: blocks entirely above the diagonal contribute nothing;
+    # blocks entirely below it need no mask at all — the iota/compare/
+    # select passes are real VPU time (the kernel is VPU-bound: softmax
+    # elementwise dwarfs the MXU matmuls at d=128), so the mask runs
+    # only on diagonal-crossing blocks
     live = True if not causal else _causal_live(q_start, k_start, block_q)
+    crosses = causal and (k_start + block_k - 1 > q_start)
 
-    @pl.when(live)
-    def _compute():
+    def _compute_body(mask):
         q = q_ref[0]  # [bq, d] native dtype
         k = k_ref[0]  # [bk, d]
         v = v_ref[0]
         s = _scores(q, k, sm_scale)  # [bq, bk] f32
-        if causal:
+        if mask:
             rows, cols = _causal_rc(q_start, k_start, block_q, block_k)
             s = jnp.where(rows >= cols, s, NEG_INF)
         m_prev = m_ref[:]
@@ -129,6 +140,12 @@ def _flash_kernel(
         )
         acc_ref[:] = acc_ref[:] * alpha + pv
         m_ref[:] = m_new
+
+    if not causal:
+        pl.when(live)(lambda: _compute_body(False))
+    else:
+        pl.when(live & jnp.logical_not(crosses))(lambda: _compute_body(False))
+        pl.when(live & crosses)(lambda: _compute_body(True))
 
     @pl.when(ki == n_k - 1)
     def _finish():
@@ -213,16 +230,16 @@ def _bwd_dq_kernel(
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     live = True if not causal else _causal_live(q_start, k_start, block_q)
+    crosses = causal and (k_start + block_k - 1 > q_start)
 
-    @pl.when(live)
-    def _compute():
+    def _compute_body(mask):
         q = q_ref[0]
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0]
         s = _scores(q, k, sm_scale)
         p = jnp.exp(s - lse_ref[0][:, :1])  # [bq, bk]
-        if causal:
+        if mask:
             rows, cols = _causal_rc(q_start, k_start, block_q, block_k)
             p = jnp.where(rows >= cols, p, 0.0)
         dp = jax.lax.dot_general(
@@ -234,6 +251,12 @@ def _bwd_dq_kernel(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+
+    if not causal:
+        pl.when(live)(lambda: _compute_body(False))
+    else:
+        pl.when(live & jnp.logical_not(crosses))(lambda: _compute_body(False))
+        pl.when(live & crosses)(lambda: _compute_body(True))
 
     @pl.when(ki == n_k - 1)
     def _finish():
@@ -269,16 +292,16 @@ def _bwd_dkv_kernel(
         dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
 
     live = True if not causal else _causal_live(q_start, k_start, block_q)
+    crosses = causal and (k_start + block_k - 1 > q_start)
 
-    @pl.when(live)
-    def _compute():
+    def _compute_body(mask):
         q = q_ref[0]
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0]
         s = _scores(q, k, sm_scale)  # [bq, bk]
         p = jnp.exp(s - lse_ref[0][:, :1])
-        if causal:
+        if mask:
             rows, cols = _causal_rc(q_start, k_start, block_q, block_k)
             p = jnp.where(rows >= cols, p, 0.0)
         dv_acc_ref[:] += jax.lax.dot_general(
@@ -294,6 +317,12 @@ def _bwd_dkv_kernel(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [bk, d]
+
+    if not causal:
+        pl.when(live)(lambda: _compute_body(False))
+    else:
+        pl.when(live & jnp.logical_not(crosses))(lambda: _compute_body(False))
+        pl.when(live & crosses)(lambda: _compute_body(True))
 
     @pl.when(qi == n_q - 1)
     def _finish():
@@ -315,12 +344,22 @@ def _flash_fwd(qb, kb, vb, groups, block_q, block_k, causal, interpret):
         qb, kb, vb, groups, block_q, block_k, causal, interpret,
         with_lse=True,
     )
-    return out, (qb, kb, vb, out, lse)
+    # named so a rematerialization policy can SAVE these two residuals
+    # (models/llama.py remat_policy="attn"): the backward then reuses
+    # them instead of re-running this kernel — q/k/v are cheap matmul
+    # recomputes, the softmax kernel is not (VPU-bound). The lse is
+    # saved COMPACT ([bh, t] — one lane of the kernel's lane-replicated
+    # layout) so the policy stores 4 bytes/row, not 512; the backward
+    # rebroadcasts at XLA level.
+    out = checkpoint_name(out, "flash_out")
+    lse_c = checkpoint_name(lse[..., 0], "flash_lse")
+    return out, (qb, kb, vb, out, lse_c)
 
 
 def _flash_bwd(groups, block_q, block_k, causal, interpret, res, do):
-    qb, kb, vb, out, lse = res
+    qb, kb, vb, out, lse_c = res
     bh, t, d = qb.shape
+    lse = jnp.broadcast_to(lse_c[..., None], (bh, t, LANES))
     sm_scale = 1.0 / np.sqrt(d)
     # delta_i = Σ_d dO_i · O_i — cheap rowwise reduce, stays in XLA,
     # lane-replicated to match the lse layout
@@ -401,13 +440,15 @@ def flash_attention(
     v: jnp.ndarray,
     causal: bool = True,
     block_q: int = 512,
-    block_k: int = 512,
+    block_k: int = 1024,
     interpret: bool = False,
 ) -> jnp.ndarray:
     """q [B, T, H, d], k/v [B, T, KV, d] with H % KV == 0 (GQA) →
     [B, T, H, d]. T must divide by the (clamped) block sizes — check
     with :func:`flash_supported`, or pad upstream. Block defaults
-    (512, 512) measured fastest on v5e at T=2048, d=128. Differentiable:
+    (512, 1024) measured fastest for train fwd+bwd on v5e at T=2048,
+    d=128 (the kernel is VPU-bound; wider kv blocks amortize the
+    running-max rescale). Differentiable:
     the FlashAttention-2-style backward (dQ sweep + dK/dV sweep pallas
     kernels, logsumexp residual) is wired via custom_vjp."""
     b, t, h, d = q.shape
@@ -415,8 +456,8 @@ def flash_attention(
     if h % hk:
         raise ValueError(f"query heads {h} not a multiple of kv heads {hk}")
     groups = h // hk
-    block_q = min(block_q, t)
-    block_k = min(block_k, t)
+    block_q = _fit_block(block_q, t)
+    block_k = _fit_block(block_k, t)
     if t % block_q or t % block_k:
         raise ValueError(
             f"seq len {t} must divide block sizes ({block_q},{block_k})"
@@ -429,9 +470,19 @@ def flash_attention(
     return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
 
-def flash_supported(t: int, block_q: int = 512, block_k: int = 512) -> bool:
+def _fit_block(block: int, t: int) -> int:
+    """Largest power-of-two block <= ``block`` that divides ``t`` (down
+    to the 128-lane tile minimum) — a seq len divisible by 512 but not
+    1024 (T=1536, 2560, ...) steps down instead of losing the kernel."""
+    block = min(block, t)
+    while block > 128 and t % block:
+        block //= 2
+    return block
+
+
+def flash_supported(t: int, block_q: int = 512, block_k: int = 1024) -> bool:
     """True when :func:`flash_attention` accepts sequence length ``t``."""
-    bq, bk = min(block_q, t), min(block_k, t)
+    bq, bk = _fit_block(block_q, t), _fit_block(block_k, t)
     return t % bq == 0 and t % bk == 0
 
 

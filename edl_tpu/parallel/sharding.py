@@ -35,6 +35,19 @@ def fsdp_pspec(shape, fsdp_size: int, tp_size: int = 1, axis: str = "fsdp") -> P
     return P()
 
 
+def fit_pspec(plan: MeshPlan, shape, *axes) -> P:
+    """PartitionSpec placing each dim on its requested axis ONLY when
+    the axis divides that dim — an elastic world is not always a power
+    of two, and a 6-way fsdp mesh must still compile; the undivisible
+    dim is replicated on that axis instead. Shared by every model's
+    param_pspecs (models/llama.py, models/moe.py)."""
+    parts = []
+    for dim, ax in zip(shape, axes):
+        ok = ax is not None and dim % plan.axis_size(ax) == 0
+        parts.append(ax if ok else None)
+    return P(*parts)
+
+
 def param_pspecs(params, plan: MeshPlan) -> Any:
     """Pytree of PartitionSpecs for a param tree: fsdp sharding when the
     plan has an fsdp axis, else fully replicated (dp). Models with tensor

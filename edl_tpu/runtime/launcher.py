@@ -50,13 +50,17 @@ class ProcessJobLauncher:
     local_devices: int = 1  # 0 = use the real backend
     work_dir: str = "."
     member_ttl_s: float = 3.0
-    lease_timeout_s: float = 4.0
+    # must comfortably exceed a worker's first-step XLA compile (~2-5 s
+    # on a cold process): a lease that times out mid-compile is
+    # redelivered and the job trains those rows twice (at-least-once)
+    lease_timeout_s: float = 10.0
     fault_tolerant: bool = True
     ckpt_every: int = 0  # periodic sharded-commit cadence (steps)
     seed: int = 0
     seq_len: int = 32  # llama workload sequence length
     data_dir: str = ""  # on-disk dataset (runtime/shards.py layout)
     step_sleep_s: float = 0.0
+    sync_every: int = 1  # delayed-sync DP: K local steps between averages
     extra_env: Dict[str, str] = field(default_factory=dict)
 
     def __post_init__(self):
@@ -103,6 +107,7 @@ class ProcessJobLauncher:
                 "EDL_LOG_DIR": self.log_dir,
                 "EDL_SEED": str(self.seed),
                 "EDL_STEP_SLEEP_S": str(self.step_sleep_s),
+                "EDL_SYNC_EVERY": str(self.sync_every),
                 "PYTHONPATH": os.pathsep.join(
                     [
                         os.path.dirname(

@@ -113,6 +113,14 @@ class WorkerConfig:
     data_dir: str = ""
     rendezvous_timeout_s: float = 120.0
     step_sleep_s: float = 0.0  # throttle (tests: keeps jobs scalable mid-run)
+    # delayed-sync DP: K local steps per dp group between cross-group
+    # averages (trainer.LocalSyncStepper; the --async_mode analog,
+    # reference example/ctr/ctr/train.py:75-79). 1 = fully synchronous.
+    # Requires a dp-only mesh. Crash semantics: grouped state cannot be
+    # snapshotted across a membership change, so a SIGKILL'd peer rolls
+    # the job back to the last committed checkpoint (cadence:
+    # ckpt_every) — graceful reshards/stops merge first and lose nothing.
+    sync_every: int = 1
 
     @classmethod
     def from_env(cls, env: Optional[Dict[str, str]] = None) -> "WorkerConfig":
@@ -148,6 +156,7 @@ class WorkerConfig:
             data_dir=e.get("EDL_DATA_DIR", ""),
             rendezvous_timeout_s=float(e.get("EDL_RENDEZVOUS_TIMEOUT_S", "120")),
             step_sleep_s=float(e.get("EDL_STEP_SLEEP_S", "0")),
+            sync_every=int(e.get("EDL_SYNC_EVERY", "1")),
         )
 
 
@@ -271,12 +280,35 @@ def _resnet_workload(cfg: WorkerConfig) -> Workload:
     )
 
 
+def _moe_workload(cfg: WorkerConfig) -> Workload:
+    """Mixture-of-Experts decoder under elastic DPxEP (no reference
+    analog — SURVEY §2.5 "Expert parallelism: NO"; mesh "ep=2,dp"
+    pins the expert axis while dp absorbs membership change)."""
+    import jax
+
+    from edl_tpu.models import moe
+
+    mcfg = moe.MoEConfig.tiny(vocab=cfg.vocab)
+
+    def batch_fn(start: int, end: int) -> Dict[str, np.ndarray]:
+        r = np.random.RandomState(cfg.seed * 1_000_003 + start + 1)
+        return moe.synthetic_tokens(r, end - start, cfg.seq_len, cfg.vocab)
+
+    return Workload(
+        lambda: moe.init_params(jax.random.PRNGKey(cfg.seed), mcfg),
+        moe.make_loss_fn(mcfg),
+        batch_fn,
+        pspecs=lambda plan: moe.param_pspecs(mcfg, plan),
+    )
+
+
 WORKLOADS: Dict[str, Callable[[WorkerConfig], Workload]] = {
     "linreg": _linreg_workload,
     "ctr": _ctr_workload,
     "llama": _llama_workload,
     "bert": _bert_workload,
     "resnet": _resnet_workload,
+    "moe": _moe_workload,
 }
 
 
@@ -753,12 +785,20 @@ class ElasticWorker:
             step = make_train_step(
                 wl.loss_fn, tx, plan, mesh, param_pspecs=pspecs, donate=False
             )
+            stepper = None
+            if cfg.sync_every > 1:
+                from edl_tpu.train.trainer import LocalSyncStepper
+
+                stepper = LocalSyncStepper(
+                    wl.loss_fn, tx, plan, mesh, donate=False
+                )
+                state = stepper.localize(state)
 
             if rank == 0:
                 self._ensure_queue(cl)
             outcome = self._train_epoch(
                 cfg, jax, cl, epoch, rank, world, plan, mesh, state, step,
-                wl.batch_fn, members,
+                wl.batch_fn, members, stepper=stepper,
             )
             self._teardown_epoch(cl, epoch, rank, members, addr)
             if outcome == "stop":
@@ -827,11 +867,19 @@ class ElasticWorker:
 
     def _train_epoch(
         self, cfg, jax, cl, epoch, rank, world, plan, mesh, state, step,
-        batch_fn, members,
+        batch_fn, members, stepper=None,
     ):
         """Lockstep loop. Returns "stop" | "reshard" with
         self._ram_snapshot holding this process's shards of the last
-        completed (or last committed, after a crash) step."""
+        completed (or last committed, after a crash) step.
+
+        With ``stepper`` (delayed-sync DP) the live state is grouped
+        (leading dp axis); every peer syncs at the same K boundary
+        (derived from the shared step counter), and commit points merge
+        to the consensus average first — both are collectives, which is
+        safe exactly where they run: on a healthy mesh under a rank-0
+        verb. The crash path cannot merge (the mesh just failed), so it
+        skips the RAM snapshot and rolls back to the last commit."""
         from edl_tpu.runtime import checkpoint as ckpt
 
         go_key = self._k("go", str(epoch))
@@ -853,7 +901,12 @@ class ElasticWorker:
                     local,
                 )
                 try:
-                    new_state, metrics = step(state, gbatch)
+                    if stepper is not None:
+                        new_state, metrics = stepper.step(state, gbatch)
+                        if (i + 1) % cfg.sync_every == 0:
+                            new_state = stepper.sync(new_state)
+                    else:
+                        new_state, metrics = step(state, gbatch)
                     loss = float(jax.device_get(metrics["loss"]))
                 except Exception as e:
                     # peer died mid-collective: recover from last
@@ -862,9 +915,20 @@ class ElasticWorker:
                     log.warn("step failed; recovering", step=i, error=str(e))
                     if task_id is not None:
                         cl.nack(task_id)
-                    snap = ckpt.snapshot_local(state)
-                    self._ram_snapshot = snap
-                    self._crash_checkpoint(cl, snap, rank, world)
+                    if stepper is None:
+                        snap = ckpt.snapshot_local(state)
+                        self._ram_snapshot = snap
+                        self._crash_checkpoint(cl, snap, rank, world)
+                    else:
+                        # grouped state cannot move across a dp-width
+                        # change and merging needs the (dead) mesh —
+                        # keep the existing RAM snapshot untouched: it
+                        # already holds the last MERGED commit
+                        # (_coordinated_checkpoint), which is exactly
+                        # the rollback point
+                        log.warn(
+                            "delayed-sync crash: rolling back to last commit"
+                        )
                     self._await_peer_reaped(cl, epoch)
                     return "reshard"
                 state = new_state
@@ -879,10 +943,16 @@ class ElasticWorker:
                     cl.kv_put(self._k("progress"), str(i + 1))
                 if verb == "ckpt":  # periodic commit of the NEW state
                     self._coordinated_checkpoint(
-                        cl, epoch, state, rank, members
+                        cl, epoch,
+                        stepper.merge(state) if stepper is not None else state,
+                        rank, members,
                     )
             else:  # stop | reshard — commit the completed state
-                self._coordinated_checkpoint(cl, epoch, state, rank, members)
+                self._coordinated_checkpoint(
+                    cl, epoch,
+                    stepper.merge(state) if stepper is not None else state,
+                    rank, members,
+                )
                 return verb
 
     def _await_peer_reaped(self, cl, failed_epoch: int) -> None:

@@ -244,6 +244,7 @@ class LocalSyncStepper:
         plan: MeshPlan,
         mesh: Mesh,
         sync_moments: bool = True,
+        donate: bool = True,
     ):
         busy = [
             a for a in ("pp", "fsdp", "sp", "ep", "tp") if plan.axis_size(a) > 1
@@ -322,17 +323,20 @@ class LocalSyncStepper:
         self._merge = jax.jit(
             _merge, in_shardings=(grouped,), out_shardings=replicated,
         )
+        # donate=False callers (the crash-tolerant worker runtime) keep
+        # pre-step buffers alive across a failed collective
+        don = (0,) if donate else ()
         self._sync = jax.jit(
             _sync,
             in_shardings=(grouped,),
             out_shardings=grouped,
-            donate_argnums=(0,),
+            donate_argnums=don,
         )
         self._step = jax.jit(
             _lstep,
             in_shardings=(grouped, batch_sh),
             out_shardings=(grouped, {"loss": replicated}),
-            donate_argnums=(0,),
+            donate_argnums=don,
         )
 
     def localize(self, state: TrainState) -> TrainState:

@@ -1,0 +1,138 @@
+"""Step-time breakdown on the flagship bench config — where do the
+milliseconds go? Each probe is independent and OOM-guarded.
+
+Run on the TPU chip: python scripts/exp_breakdown.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import getpass
+import tempfile
+
+import jax
+
+_cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
+    tempfile.gettempdir(), f"edl_jax_cache_{getpass.getuser()}"
+)
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+
+import jax.numpy as jnp
+import numpy as np
+
+from edl_tpu.models import llama
+
+B, T = 16, 2048
+PEAK = 197e12
+
+
+def fence(out):
+    # tunneled backends: block_until_ready can return before the device
+    # work completes — a dependent scalar fetch is the reliable fence
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    return float(jnp.sum(jnp.ravel(leaf)[:1]))
+
+
+def timeit(fn, *args, reps=4):
+    out = fn(*args)
+    fence(out)
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn(*args)
+        fence(out)
+        best = min(best, (time.perf_counter() - t0) / reps)
+    del out
+    return best
+
+
+def probe(name, flops, build):
+    try:
+        t = build()
+        print(f"{name:16s} {t*1e3:8.1f} ms   {flops/t/1e12:6.1f} TF/s "
+              f"({flops/t/PEAK*100:4.1f}% peak)", flush=True)
+    except Exception as e:
+        print(f"{name:16s} FAILED: {str(e)[:120]}", flush=True)
+    finally:
+        jax.clear_caches()
+
+
+def main():
+    rng = np.random.RandomState(0)
+    print(f"platform={jax.devices()[0].platform}", flush=True)
+
+    # 1. pure big-matmul ceiling: [B*T, d] x [d, ff] chain
+    def matmul_probe():
+        x = jnp.asarray(rng.standard_normal((B * T, 2048)), jnp.bfloat16)
+        w1 = jnp.asarray(rng.standard_normal((2048, 6144)), jnp.bfloat16)
+        w2 = jnp.asarray(rng.standard_normal((6144, 2048)), jnp.bfloat16)
+
+        @jax.jit
+        def f(x):
+            for _ in range(4):
+                x = (x @ w1) @ w2
+            return x
+
+        return timeit(f, x)
+
+    probe("matmul chain", 8 * 2 * B * T * 2048 * 6144, matmul_probe)
+
+    # 2. flash attention fwd / fwd+bwd at bench shape
+    from edl_tpu.ops import flash_attention as fa
+
+    q = jnp.asarray(rng.standard_normal((B, T, 16, 128)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((B, T, 16, 128)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((B, T, 16, 128)), jnp.bfloat16)
+    att_flops = B * 16 * (T * T / 2) * 4 * 128
+
+    probe(
+        "flash fwd",
+        att_flops,
+        lambda: timeit(jax.jit(lambda q, k, v: fa.flash_attention(q, k, v, causal=True)), q, k, v),
+    )
+    probe(
+        "flash fwd+bwd",
+        3 * att_flops,
+        lambda: timeit(
+            jax.jit(jax.grad(lambda q, k, v: fa.flash_attention(q, k, v, causal=True).astype(jnp.float32).sum(), (0, 1, 2))),
+            q, k, v,
+        ),
+    )
+
+    # 3. model fwd, flash vs XLA attention
+    import optax
+    from edl_tpu.parallel.mesh import MeshPlan
+    from edl_tpu.train.trainer import TrainState, shard_state
+
+    plan = MeshPlan.data_parallel(1)
+    mesh = plan.build()
+    fpt = None
+    for name, use_flash in (("fwd flash", True), ("fwd xla-attn", False)):
+        def fwd_probe(use_flash=use_flash):
+            cfg = llama.LlamaConfig(
+                vocab=32768, d_model=2048, n_layers=16, n_heads=16,
+                n_kv_heads=8, d_ff=6144, dtype=jnp.bfloat16,
+                use_flash=use_flash, remat=True,
+            )
+            params = jax.jit(lambda: llama.init_params(jax.random.PRNGKey(1), cfg))()
+            batch = llama.synthetic_tokens(rng, B, T, cfg.vocab)
+            loss = jax.jit(llama.make_loss_fn(cfg))
+            t = timeit(loss, params, batch)
+            del params
+            return t
+
+        cfg0 = llama.LlamaConfig(
+            vocab=32768, d_model=2048, n_layers=16, n_heads=16,
+            n_kv_heads=8, d_ff=6144,
+        )
+        fpt = llama.train_flops_per_token(cfg0, T)
+        probe(name, fpt / 6 * 2 * B * T, fwd_probe)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,136 @@
+"""MFU experiment harness — sweep remat policy x per-chip batch on the
+flagship bench config and print tokens/s/chip + model-MFU per variant.
+
+Not part of the bench; used to pick the config bench.py ships with.
+Run on the TPU chip: python scripts/exp_mfu.py [variant ...]
+Variant grammar: <batch>:<policy>  e.g. 16:full 8:mlp 4:dots 4:none
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import getpass
+import tempfile
+
+import jax
+
+_cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
+    tempfile.gettempdir(), f"edl_jax_cache_{getpass.getuser()}"
+)
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from edl_tpu.models import llama
+from edl_tpu.parallel.mesh import MeshPlan
+from edl_tpu.train.trainer import (
+    TrainState,
+    make_train_multistep,
+    shard_state,
+    stack_batches,
+)
+
+T = 2048
+STEPS_PER_DISPATCH = 2
+DISPATCHES = 4
+PEAK = 197e12  # v5e bf16
+
+
+def run_variant(per_chip: int, policy: str, plan, mesh, rng) -> float:
+    remat = policy != "none"
+    cfg = llama.LlamaConfig(
+        vocab=32768,
+        d_model=2048,
+        n_layers=16,
+        n_heads=16,
+        n_kv_heads=8,
+        d_ff=6144,
+        dtype=jnp.bfloat16,
+        use_flash=True,
+        remat=remat,
+        remat_policy=policy if remat else "full",
+    )
+    n_dev = len(jax.devices())
+    tx = optax.adafactor(1e-3)
+    pspecs = llama.param_pspecs(cfg, plan)
+    lb = per_chip * n_dev
+    state = toks = None
+    try:
+        state = jax.jit(
+            lambda: TrainState.create(
+                llama.init_params(jax.random.PRNGKey(1), cfg), tx
+            )
+        )()
+        state = shard_state(state, plan, mesh, pspecs)
+        toks = stack_batches(
+            [
+                llama.synthetic_tokens(rng, lb, T, cfg.vocab)
+                for _ in range(STEPS_PER_DISPATCH)
+            ],
+            plan,
+            mesh,
+        )
+        multi = make_train_multistep(
+            llama.make_loss_fn(cfg), tx, plan, mesh, pspecs
+        )
+        t0 = time.perf_counter()
+        state, m = multi(state, toks)
+        float(m["loss"])
+        compile_s = time.perf_counter() - t0
+        rate = 0.0
+        for _ in range(2):
+            t1 = time.perf_counter()
+            for _ in range(DISPATCHES):
+                state, m = multi(state, toks)
+            float(m["loss"])
+            rate = max(
+                rate,
+                DISPATCHES
+                * STEPS_PER_DISPATCH
+                * lb
+                * T
+                / (time.perf_counter() - t1)
+                / n_dev,
+            )
+        fpt = llama.train_flops_per_token(cfg, T)
+        print(
+            f"b{per_chip}:{policy:5s}  {rate:9.0f} tok/s/chip  "
+            f"mfu={rate * fpt / PEAK:.4f}  compile={compile_s:.0f}s",
+            flush=True,
+        )
+        return rate
+    except Exception as e:
+        print(f"b{per_chip}:{policy:5s}  FAILED: {str(e)[:140]}", flush=True)
+        return 0.0
+    finally:
+        del state, toks
+        jax.clear_caches()
+
+
+def main():
+    variants = sys.argv[1:] or [
+        "16:full",
+        "8:mlp",
+        "4:mlp",
+        "8:dots",
+        "4:dots",
+        "4:none",
+    ]
+    n_dev = len(jax.devices())
+    plan = MeshPlan.data_parallel(n_dev)
+    mesh = plan.build()
+    rng = np.random.RandomState(0)
+    print(f"platform={jax.devices()[0].platform} devices={n_dev}", flush=True)
+    for v in variants:
+        b, p = v.split(":")
+        run_variant(int(b), p, plan, mesh, rng)
+
+
+if __name__ == "__main__":
+    main()

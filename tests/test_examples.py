@@ -133,3 +133,19 @@ def test_resnet_elastic_train(monkeypatch, capsys):
     )
     out = capsys.readouterr().out
     assert "phase=succeeded" in out and "reshards=1" in out
+
+
+def test_moe_elastic_pretrain(monkeypatch, capsys):
+    """Expert parallelism as a workload (no reference analog): MoE
+    decoder on an ep=2,dp mesh through the multi-process runtime; the
+    mid-run scale-up grows dp while the pinned expert axis survives."""
+    assert (
+        _run_example(
+            monkeypatch,
+            "moe/train.py",
+            ["--samples", "512", "--seq-len", "24", "--step-sleep", "0.3"],
+        )
+        == 0
+    )
+    out = capsys.readouterr().out
+    assert "phase=succeeded" in out and "reshards=1" in out

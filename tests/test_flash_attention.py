@@ -41,7 +41,11 @@ def test_flash_rejects_ragged_seq():
     q = jnp.zeros((1, 100, 1, 16))
     with pytest.raises(ValueError):
         flash_attention(q, q, q, block_q=64, block_k=64, interpret=True)
-    assert not flash_supported(640)  # 640 % 512 != 0
+    # blocks step DOWN to the largest power-of-two divisor >= 128, so
+    # any multiple of 128 is supported (640 -> blocks of 128; 1536 ->
+    # block_k 512); only non-multiples of 128 are rejected
+    assert flash_supported(640)
+    assert flash_supported(1536)
     assert flash_supported(384)  # block_k clamps to 384
     assert flash_supported(2048)
 

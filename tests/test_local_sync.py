@@ -165,3 +165,35 @@ def test_stepper_rejects_param_sharded_mesh(cpu_devices):
     mesh = plan.build()
     with pytest.raises(ValueError, match="dp-only"):
         LocalSyncStepper(ctr.loss_fn, optax.adam(1e-3), plan, mesh)
+
+
+def test_multiproc_delayed_sync_scale_up(tmp_path):
+    """Delayed-sync DP through the REAL multi-process runtime
+    (EDL_SYNC_EVERY): K=2 local steps between averages, scaled up
+    mid-run. The rescale merges the groups (collective on the healthy
+    mesh), reshards, and re-forms them at the new dp width."""
+    from edl_tpu.runtime.launcher import ProcessJobLauncher
+
+    with ProcessJobLauncher(
+        job="mpsync",
+        model="linreg",
+        min_workers=1,
+        max_workers=4,
+        n_samples=4096,
+        passes=1,
+        per_device_batch=32,
+        step_sleep_s=0.05,
+        sync_every=2,
+        work_dir=str(tmp_path),
+    ) as launcher:
+        launcher.start(2)
+        launcher.wait_progress(3, timeout_s=120)
+        launcher.scale_to(3)
+        rcs = launcher.wait(timeout_s=240)
+        assert all(rc == 0 for rc in rcs.values()), (
+            rcs,
+            {w: launcher.log_tail(w, 4000) for w in rcs},
+        )
+        assert launcher.kv("phase") == "succeeded"
+        assert int(launcher.kv("reshards") or "0") >= 1
+        assert float(launcher.kv("loss_last")) < float(launcher.kv("loss_first"))

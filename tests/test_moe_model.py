@@ -1,0 +1,81 @@
+"""MoE transformer model family — expert parallelism as a full model
+(reference has none; SURVEY §2.5 "Expert parallelism: NO")."""
+
+import numpy as np
+import optax
+
+import jax
+import jax.numpy as jnp
+
+from edl_tpu.models import moe
+from edl_tpu.parallel.mesh import MeshPlan
+from edl_tpu.parallel import sharding as shd
+from edl_tpu.train.trainer import (
+    TrainState,
+    global_batch,
+    make_train_step,
+    shard_state,
+)
+
+
+def _run(plan, steps=20, seed=0):
+    cfg = moe.MoEConfig.tiny()
+    mesh = plan.build()
+    params = moe.init_params(jax.random.PRNGKey(1), cfg)
+    tx = optax.adam(3e-3)
+    pspecs = moe.param_pspecs(cfg, plan)
+    state = shard_state(TrainState.create(params, tx), plan, mesh, pspecs)
+    step = make_train_step(moe.make_loss_fn(cfg), tx, plan, mesh, pspecs)
+    rng = np.random.RandomState(seed)
+    losses = []
+    for _ in range(steps):
+        b = moe.synthetic_tokens(rng, 16, 32, cfg.vocab)
+        state, m = step(state, global_batch(b, plan, mesh))
+        losses.append(float(m["loss"]))
+    return losses, state
+
+
+def test_moe_learns(cpu_devices):
+    losses, _ = _run(MeshPlan.data_parallel(4), steps=30)
+    assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
+
+
+def test_moe_ep_parity_with_dp(cpu_devices):
+    """Expert-parallel sharding is a layout choice, not a math change:
+    ep=2 must reproduce the dp-only loss curve."""
+    dp_losses, _ = _run(MeshPlan.data_parallel(4))
+    ep_losses, state = _run(MeshPlan.create(dp=2, ep=2))
+    np.testing.assert_allclose(dp_losses, ep_losses, rtol=2e-4, atol=2e-5)
+    # experts actually sharded: each device holds E/2 experts of w_in
+    w_in = state.params["layers"]["w_in"]
+    shapes = {s.data.shape for s in w_in.addressable_shards}
+    cfg = moe.MoEConfig.tiny()
+    assert shapes == {
+        (cfg.n_layers, cfg.n_experts // 2, cfg.d_model, cfg.d_ff)
+    }
+
+
+def test_moe_elastic_reshard(cpu_devices):
+    """MoE through the elastic trainer: ep pinned at 2, dp grows."""
+    import optax as _o
+
+    from edl_tpu.api.job import MeshSpec
+    from edl_tpu.runtime.elastic import ElasticTrainer
+
+    cfg = moe.MoEConfig.tiny()
+    tr = ElasticTrainer(
+        moe.make_loss_fn(cfg),
+        _o.adam(3e-3),
+        mesh_spec=MeshSpec(ep=2),
+        per_chip_batch=8,
+        param_pspecs=lambda plan: moe.param_pspecs(cfg, plan),
+    )
+    tr.start(moe.init_params(jax.random.PRNGKey(0), cfg), 2)
+    rng = np.random.RandomState(1)
+    data = lambda bs: moe.synthetic_tokens(rng, bs, 32, cfg.vocab)
+    tr.train_steps(data, 4)
+    tr.request_rescale(4)  # 4 workers x 1 chip: dp 1->2, ep stays 2
+    rep = tr.train_steps(data, 8)
+    assert [(e.from_workers, e.to_workers) for e in rep.reshards] == [(2, 4)]
+    assert tr.plan.axis_size("ep") == 2
+    assert np.mean(rep.losses[-4:]) < rep.losses[0]
