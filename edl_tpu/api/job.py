@@ -229,6 +229,9 @@ class TrainingJobStatus:
     parallelism: int = 0  # current worker target (trainer Job .Spec.Parallelism analog)
     reshard_count: int = 0  # elastic reshard events so far (new: observability)
     last_reshard_stall_s: float = 0.0
+    # reshards that fell back to host-RAM staging (the slow path whose
+    # worst case doc/reshard_stall.md bounds) — a monitor alarm signal
+    reshard_fallbacks: int = 0
 
 
 def qualify(namespace: str, name: str) -> str:

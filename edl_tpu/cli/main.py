@@ -83,6 +83,7 @@ def _job_status_record(cluster, job: TrainingJob) -> dict:
         "pending": pending,
         "reshard_count": st.reshard_count,
         "last_reshard_stall_s": st.last_reshard_stall_s,
+        "reshard_fallbacks": st.reshard_fallbacks,
         "min_replicas": job.spec.worker.min_replicas,
         "max_replicas": job.spec.worker.max_replicas,
         "chips_per_worker": job.chips_per_worker(),

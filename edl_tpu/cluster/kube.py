@@ -612,6 +612,7 @@ class KubeCluster(Cluster):
                     "parallelism": st.parallelism,
                     "reshard_count": st.reshard_count,
                     "last_reshard_stall_s": st.last_reshard_stall_s,
+                    "reshard_fallbacks": st.reshard_fallbacks,
                     "worker": {
                         "state": st.worker.state.value,
                         "replicas": st.worker.replicas,

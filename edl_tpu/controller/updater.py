@@ -188,9 +188,11 @@ class JobUpdater:
             self.job.status.reshard_count += 1
             self._scaling_since = time.monotonic()
 
-    def on_reshard_done(self, stall_s: float) -> None:
+    def on_reshard_done(self, stall_s: float, fallback: bool = False) -> None:
         if self.phase == JobPhase.SCALING:
             self.job.status.last_reshard_stall_s = stall_s
+            if fallback:
+                self.job.status.reshard_fallbacks += 1
             self._scaling_since = None
             self._set_phase(JobPhase.RUNNING)
 

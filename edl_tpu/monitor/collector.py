@@ -37,6 +37,9 @@ class MonitorSample:
     phases: Dict[str, str] = field(default_factory=dict)
     reshards: Dict[str, int] = field(default_factory=dict)
     last_stall_s: Dict[str, float] = field(default_factory=dict)
+    # host-staged (slow-path) reshards — alarm signal, see
+    # doc/reshard_stall.md
+    reshard_fallbacks: Dict[str, int] = field(default_factory=dict)
     cpu_total_milli: int = 0
     cpu_request_milli: int = 0
     chip_total: int = 0
@@ -75,6 +78,10 @@ class MonitorSample:
                     f"reshards={self.reshards[name]}"
                     f" last_stall={self.last_stall_s.get(name, 0.0):.2f}s"
                 )
+                if self.reshard_fallbacks.get(name):
+                    extras.append(
+                        f"host_fallbacks={self.reshard_fallbacks[name]}"
+                    )
             suffix = (" [" + " ".join(extras) + "]") if extras else ""
             lines.append(f"  {name}: {n}{suffix}")
         lines.append(f"CPU-UTILS: {self.cpu_util:.2f}%")
@@ -110,6 +117,7 @@ class ClusterSource:
             s.phases[job.name] = str(job.status.phase.value)
             s.reshards[job.name] = job.status.reshard_count
             s.last_stall_s[job.name] = job.status.last_reshard_stall_s
+            s.reshard_fallbacks[job.name] = job.status.reshard_fallbacks
         return s
 
 
@@ -138,6 +146,7 @@ class StoreSource:
             s.phases[name] = st.get("phase", "none")
             s.reshards[name] = st.get("reshard_count", 0)
             s.last_stall_s[name] = st.get("last_reshard_stall_s", 0.0)
+            s.reshard_fallbacks[name] = st.get("reshard_fallbacks", 0)
         return s
 
 

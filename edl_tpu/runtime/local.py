@@ -87,7 +87,7 @@ class LocalJobRunner:
     def _reshard_done(self, ev: ReshardEvent) -> None:
         u = self.controller.updaters.get(self.job.qualified_name)
         if u is not None:
-            u.on_reshard_done(ev.stall_s)
+            u.on_reshard_done(ev.stall_s, fallback=ev.fallback)
 
     def sync_membership(self) -> None:
         """Reshard down to the live worker count when members die without

@@ -420,6 +420,7 @@ class ElasticWorker:
         # last snapshot of THIS process's addressable shards (the RAM
         # half of the reshard protocol; disk holds the committed union)
         self._ram_snapshot = None  # checkpoint.LocalSnapshot
+        self._pending_commit: Optional[threading.Thread] = None
         self._last_local: Optional[Dict[str, np.ndarray]] = None
         self._resharded = 0
         self._local_rows = 0  # batch rows this process feeds per step
@@ -576,66 +577,170 @@ class ElasticWorker:
             )()
         return state, pspecs
 
-    def _coordinated_checkpoint(self, cl, epoch, state, rank, members):
+    def _join_pending_commit(self) -> None:
+        """At most ONE background commit is in flight; the next commit,
+        a crash rescue, or an epoch teardown serializes behind it."""
+        t = self._pending_commit
+        if t is None:
+            return
+        t.join(self.cfg.ckpt_commit_timeout_s + 30)
+        if t.is_alive():  # pragma: no cover - hung storage
+            log.error("background checkpoint commit did not finish in time")
+        self._pending_commit = None
+
+    def _coordinated_checkpoint(
+        self, cl, epoch, state, rank, members, background=False
+    ):
         """Commit the state as a sharded checkpoint: every member writes
         its primary shards, the leader (lowest live rank) awaits all
         marks and commits manifest.json last. A member dying mid-write
         aborts the commit (its primary shards are unrecoverable), and
-        the previous committed step remains the restore point."""
+        the previous committed step remains the restore point.
+
+        ``background=True`` (the periodic "ckpt" verb): the host-RAM
+        snapshot is taken synchronously — the device state mutates next
+        step — but the disk write, mark posting, and the leader's
+        mark-wait + manifest commit run on a writer thread with its own
+        coordinator connection, so multi-GB shard writes overlap
+        training instead of stalling it. Stop/reshard commits stay
+        synchronous: teardown must not outrun the manifest."""
         from edl_tpu.runtime import checkpoint as ckpt
 
         cfg = self.cfg
+        self._join_pending_commit()
         snap = ckpt.snapshot_local(state)
         self._ram_snapshot = snap
         if not cfg.ckpt_dir:
             return
-        world = len(members)
-        alive = {m.name for m in cl.members()}
-        leader = min((m.rank for m in members if m.name in alive), default=rank)
-        fname = ckpt.save_shards(
-            cfg.ckpt_dir, snap, rank, world, host_leaves=(rank == leader)
-        )
-        mark = lambda n: self._k("ckmark", str(epoch), str(snap.step), n)  # noqa: E731
-        cl.kv_put(mark(cfg.worker_id), fname)
-        if rank != leader:
+        # A reshard/stop at the same step a background "ckpt" commit
+        # just finished would re-commit an identical state — and the
+        # finished commit's mark-cleanup can race the re-commit's fresh
+        # marks (same (epoch, step, worker) keys), stranding the leader
+        # in its mark wait. The leader's view of ckpt_step is
+        # authoritative here: it joined the very thread that wrote it.
+        if int(cl.kv_get(self._k("ckpt_step")) or "-1") >= snap.step:
             return
-        # scale the commit deadline with shard size is the caller's job
-        # (EDL_CKPT_COMMIT_TIMEOUT_S); the default must accommodate
-        # multi-GB shard writes to shared storage
-        deadline = time.monotonic() + cfg.ckpt_commit_timeout_s
-        files = None
-        while time.monotonic() < deadline:
-            cl.expire()
-            alive = {m.name for m in cl.members()}
-            got, waiting, dead_unwritten = [], [], []
-            for m in members:
-                v = cl.kv_get(mark(m.name))
-                if v:
-                    got.append(v)
-                elif m.name in alive:
-                    waiting.append(m.name)
+        world = len(members)
+
+        def _write(client, own_client: bool) -> None:
+            try:
+                alive = {m.name for m in client.members()}
+                leader = min(
+                    (m.rank for m in members if m.name in alive), default=rank
+                )
+                own = os.path.join(
+                    ckpt.step_dir(cfg.ckpt_dir, snap.step),
+                    ckpt.shard_filename(rank, world),
+                )
+                if rank != leader and os.path.exists(own):
+                    # a background commit of this exact step already
+                    # wrote this rank's shards (atomic rename => the
+                    # file is complete) but its manifest aborted; a
+                    # non-leader's stale read of ckpt_step cannot see
+                    # that — reuse the file, only re-post the mark
+                    fname = os.path.basename(own)
                 else:
-                    dead_unwritten.append(m.name)
-            if not waiting:
-                files = got if not dead_unwritten else None
-                break
-            time.sleep(_POLL_S)
-        for m in members:  # marks served their purpose either way
-            cl.kv_del(mark(m.name))
-        if files:
-            ckpt.write_manifest(cfg.ckpt_dir, snap, files, {"job": cfg.job})
-            cl.kv_put(self._k("ckpt_step"), str(snap.step))
-            ckpt.gc_step_dirs(cfg.ckpt_dir, keep=2)
-        else:  # pragma: no cover - crash-timing path
-            # surfaced as a counter so monitors can alarm on repeated
-            # aborts (a job silently training without restore points)
-            aborts = int(cl.kv_get(self._k("ckpt_aborts")) or "0") + 1
-            cl.kv_put(self._k("ckpt_aborts"), str(aborts))
-            log.error(
-                "checkpoint commit aborted (peer died or write timed out)",
-                step=snap.step,
-                aborts=aborts,
-            )
+                    fname = ckpt.save_shards(
+                        cfg.ckpt_dir, snap, rank, world,
+                        host_leaves=(rank == leader),
+                    )
+                mark = lambda n: self._k(  # noqa: E731
+                    "ckmark", str(epoch), str(snap.step), n
+                )
+                client.kv_put(mark(cfg.worker_id), fname)
+                if rank != leader:
+                    return
+                # scale the commit deadline with shard size is the
+                # caller's job (EDL_CKPT_COMMIT_TIMEOUT_S); the default
+                # must accommodate multi-GB writes to shared storage
+                deadline = time.monotonic() + cfg.ckpt_commit_timeout_s
+                files = None
+                while time.monotonic() < deadline:
+                    client.expire()
+                    alive = {m.name for m in client.members()}
+                    got, waiting, dead_unwritten = [], [], []
+                    for m in members:
+                        v = client.kv_get(mark(m.name))
+                        if v:
+                            got.append(v)
+                        elif m.name in alive:
+                            waiting.append(m.name)
+                        else:
+                            dead_unwritten.append(m.name)
+                    if not waiting:
+                        files = got if not dead_unwritten else None
+                        break
+                    time.sleep(_POLL_S)
+                for m in members:  # marks served their purpose either way
+                    client.kv_del(mark(m.name))
+                if files:
+                    ckpt.write_manifest(
+                        cfg.ckpt_dir, snap, files, {"job": cfg.job}
+                    )
+                    # monotonic max-write: a commit thread that stalled
+                    # past its join timeout must not regress the
+                    # pointer a LATER commit already advanced
+                    cur = int(client.kv_get(self._k("ckpt_step")) or "-1")
+                    if snap.step > cur:
+                        client.kv_put(self._k("ckpt_step"), str(snap.step))
+                    ckpt.gc_step_dirs(cfg.ckpt_dir, keep=2)
+                else:  # pragma: no cover - crash-timing path
+                    # surfaced as a counter so monitors can alarm on
+                    # repeated aborts (a job silently training without
+                    # restore points)
+                    aborts = int(
+                        client.kv_get(self._k("ckpt_aborts")) or "0"
+                    ) + 1
+                    client.kv_put(self._k("ckpt_aborts"), str(aborts))
+                    log.error(
+                        "checkpoint commit aborted "
+                        "(peer died or write timed out)",
+                        step=snap.step,
+                        aborts=aborts,
+                    )
+            except Exception as e:  # pragma: no cover - storage faults
+                log.error("checkpoint commit failed", error=str(e))
+                try:
+                    aborts = int(
+                        client.kv_get(self._k("ckpt_aborts")) or "0"
+                    ) + 1
+                    client.kv_put(self._k("ckpt_aborts"), str(aborts))
+                except Exception:
+                    pass
+                if not own_client:
+                    # synchronous (stop/reshard) commits must not be
+                    # silently lost: the job would report success with
+                    # a stale restore point
+                    raise
+            finally:
+                if own_client:
+                    try:
+                        client.close()
+                    except Exception:
+                        pass
+
+        if not background:
+            _write(cl, own_client=False)
+            return
+
+        def _bg():
+            try:
+                client = CoordinatorClient(
+                    cfg.coord_host, cfg.coord_port, 10.0
+                )
+            except Exception as e:  # pragma: no cover - coord hiccup
+                log.error(
+                    "background commit could not reach coordinator",
+                    error=str(e),
+                )
+                return
+            _write(client, own_client=True)
+
+        t = threading.Thread(
+            target=_bg, name="edl-ckpt-commit", daemon=True
+        )
+        t.start()
+        self._pending_commit = t
 
     def _crash_checkpoint(self, cl, snap, rank, world) -> None:
         """After a failed collective any survivor may be the only one
@@ -649,6 +754,7 @@ class ElasticWorker:
 
         if not self.cfg.ckpt_dir:
             return
+        self._join_pending_commit()  # serialize behind an in-flight commit
         known = int(cl.kv_get(self._k("ckpt_step")) or "-1")
         if snap.step <= known or not snap.is_complete():
             return
@@ -941,11 +1047,12 @@ class ElasticWorker:
                         cl.kv_put(first_loss_key, repr(loss))
                     cl.kv_put(self._k("loss_last"), repr(loss))
                     cl.kv_put(self._k("progress"), str(i + 1))
-                if verb == "ckpt":  # periodic commit of the NEW state
+                if verb == "ckpt":  # periodic commit of the NEW state,
+                    # written behind the continuing step loop
                     self._coordinated_checkpoint(
                         cl, epoch,
                         stepper.merge(state) if stepper is not None else state,
-                        rank, members,
+                        rank, members, background=True,
                     )
             else:  # stop | reshard — commit the completed state
                 self._coordinated_checkpoint(

@@ -194,3 +194,19 @@ def test_monitor_cluster_source_pending_detection():
     Collector(ClusterSource(cluster), interval_s=0, out=buf).run(n_polls=2)
     text = buf.getvalue()
     assert text.count("SUBMITTED-JOBS") == 2
+
+
+def test_monitor_renders_host_fallbacks():
+    """Slow-path (host-staged) reshards surface in the monitor output
+    as an alarm signal (doc/reshard_stall.md)."""
+    from edl_tpu.monitor.collector import MonitorSample
+
+    s = MonitorSample(
+        submitted_jobs=["j"],
+        running_workers={"j": 2},
+        reshards={"j": 3},
+        last_stall_s={"j": 0.5},
+        reshard_fallbacks={"j": 1},
+    )
+    out = s.render()
+    assert "reshards=3" in out and "host_fallbacks=1" in out

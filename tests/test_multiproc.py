@@ -359,3 +359,34 @@ def test_crash_sigkill_rank0_survivors_recover(tmp_path):
         )
         assert launcher.kv("phase") == "succeeded"
         assert float(launcher.kv("loss_last")) < float(launcher.kv("loss_first"))
+
+
+def test_background_commits_under_rescale(tmp_path):
+    """Periodic commits run on a writer thread behind the step loop
+    (background=True for the "ckpt" verb). ckpt_every=1 keeps a commit
+    in flight at every step; a mid-run scale-up must serialize behind
+    the pending write (join) and the final manifest must carry the
+    final step."""
+    with ProcessJobLauncher(
+        job="mpbg",
+        model="linreg",
+        min_workers=1,
+        max_workers=3,
+        n_samples=4096,
+        passes=1,
+        per_device_batch=32,
+        step_sleep_s=0.05,
+        ckpt_every=1,
+        work_dir=str(tmp_path),
+    ) as launcher:
+        launcher.start(2)
+        launcher.wait_progress(3, timeout_s=120)
+        launcher.scale_to(3)
+        rcs = launcher.wait(timeout_s=240)
+        _assert_succeeded(launcher, rcs)
+        assert int(launcher.kv("reshards") or "0") >= 1
+        manifest = ckpt.latest_manifest(launcher.ckpt_dir)
+        assert manifest is not None
+        assert manifest["step"] == launcher.progress()
+        assert int(launcher.kv("ckpt_step")) == launcher.progress()
+        assert float(launcher.kv("loss_last")) < float(launcher.kv("loss_first"))
