@@ -159,10 +159,15 @@ def make_loss_fn(cfg: BertConfig):
 
     def loss_fn(params, batch):
         logits = forward(params, batch["tokens"], cfg)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        ll = jnp.take_along_axis(logp, batch["targets"][..., None], axis=-1)[..., 0]
+        # fused CE (see models/llama.py): no [B,T,V] log-softmax
+        # materialization
+        import optax
+
+        ce = optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["targets"]
+        )
         mask = batch["mask"].astype(jnp.float32)
-        return -jnp.sum(ll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        return jnp.sum(ce * mask) / jnp.maximum(jnp.sum(mask), 1.0)
 
     return loss_fn
 

@@ -274,9 +274,14 @@ def make_loss_fn(cfg: LlamaConfig):
         tokens = batch["tokens"]
         logits = forward(params, tokens[:, :-1], cfg)
         targets = tokens[:, 1:]
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-        return -jnp.mean(ll)
+        # fused CE (logsumexp - target logit): two reductions over the
+        # vocab axis instead of materializing the full [B,T,V]
+        # log-softmax (4+ GB of f32 at the bench config)
+        import optax
+
+        return jnp.mean(
+            optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+        )
 
     return loss_fn
 

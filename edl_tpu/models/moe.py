@@ -211,8 +211,13 @@ def make_loss_fn(cfg: MoEConfig):
         tokens = batch["tokens"]
         logits, aux = forward(params, tokens[:, :-1], cfg)
         targets = tokens[:, 1:]
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-        return -jnp.mean(ll) + cfg.aux_coef * aux
+        # fused CE (see models/llama.py): no [B,T,V] log-softmax
+        # materialization
+        import optax
+
+        ce = jnp.mean(
+            optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+        )
+        return ce + cfg.aux_coef * aux
 
     return loss_fn

@@ -168,9 +168,13 @@ def make_loss_fn(cfg: ResNetConfig):
 
     def loss_fn(params, batch):
         logits = forward(params, batch["images"], cfg)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        ll = jnp.take_along_axis(logp, batch["label"][:, None], axis=-1)[:, 0]
-        return -jnp.mean(ll)
+        import optax
+
+        return jnp.mean(
+            optax.softmax_cross_entropy_with_integer_labels(
+                logits, batch["label"]
+            )
+        )
 
     return loss_fn
 
