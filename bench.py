@@ -72,49 +72,18 @@ def _peak_flops(device) -> float:
     return 197e12  # assume v5e (the bench fleet) when the kind is opaque
 
 
-def _llama_flagship_bench(n_dev, plan, mesh, rng) -> dict:
-    """Flagship train throughput + MFU. On TPU: d2048/L16/ff6144,
-    vocab 32k, T=2048, bf16 activations, pallas flash attention,
-    per-layer remat, adafactor (factored moments — Adam's 8 GB of f32
-    moments don't fit beside 3.8 GB of f32 params in 16 GB HBM).
-    Off-TPU: a tiny config keeps the script smoke-runnable."""
+def _llama_measure(lcfg, lt, ladder, lsteps, lreps, n_dev, plan, mesh, rng):
+    """Train-throughput ladder for one llama config: walk per-chip batch
+    sizes down until one fits, return (tokens/s/chip, used_batch,
+    state_gb). OOM (or any per-rung failure: a too-big program can also
+    kill the remote compile helper) steps down; only the LAST rung's
+    failure propagates."""
     import optax
 
     from edl_tpu.models import llama
 
-    on_tpu = jax.devices()[0].platform == "tpu"
-    if on_tpu:
-        lcfg = llama.LlamaConfig(
-            vocab=32768,
-            d_model=2048,
-            n_layers=16,
-            n_heads=16,
-            n_kv_heads=8,
-            d_ff=6144,
-            dtype=jnp.bfloat16,
-            use_flash=True,
-            remat=True,
-        )
-        lt, ladder = 2048, (16, 8, 4, 2)
-        lsteps, lreps = 2, 4  # fused steps/dispatch, dispatches/loop
-    else:  # smoke config: exercise the same code path cheaply
-        lcfg = llama.LlamaConfig(
-            vocab=1024,
-            d_model=128,
-            n_layers=2,
-            n_heads=4,
-            n_kv_heads=2,
-            d_ff=384,
-            dtype=jnp.float32,
-            remat=True,
-        )
-        lt, ladder = 256, (2,)
-        lsteps, lreps = 2, 2
     ltx = optax.adafactor(1e-3)
     pspecs = llama.param_pspecs(lcfg, plan)
-
-    ltok_rate, used_batch = 0.0, 0
-    reshard_metrics = {}
     for per_chip in ladder:
         lb = per_chip * n_dev
         ltok_rate = 0.0  # a partially-timed bigger rung must not leak in
@@ -148,18 +117,11 @@ def _llama_flagship_bench(n_dev, plan, mesh, rng) -> dict:
                     ltok_rate,
                     lreps * lsteps * lb * lt / (time.perf_counter() - t3) / n_dev,
                 )
-            used_batch = per_chip
-            reshard_metrics = {
-                "flagship_state_gb": round(
-                    ckpt.state_nbytes(lstate) / (1 << 30), 2
-                ),
-            }
+            state_gb = ckpt.state_nbytes(lstate) / (1 << 30)
             del lstate, ltoks
-            break
+            jax.clear_caches()
+            return ltok_rate, per_chip, state_gb
         except Exception as e:
-            # OOM (or any per-rung failure: a too-big program can also
-            # kill the remote compile helper): step down; only a failure
-            # on the LAST rung propagates
             if per_chip == ladder[-1]:
                 raise
             print(
@@ -168,8 +130,62 @@ def _llama_flagship_bench(n_dev, plan, mesh, rng) -> dict:
             )
             del lstate, ltoks  # free the failed rung's HBM first
             jax.clear_caches()
+    return 0.0, 0, 0.0  # pragma: no cover - ladder always returns/raises
+
+
+def _llama_flagship_bench(n_dev, plan, mesh, rng) -> dict:
+    """Flagship train throughput + MFU, plus a LONG-CONTEXT rung.
+    On TPU the flagship is d2048/L16/ff6144, vocab 32k, T=2048, bf16
+    activations, pallas flash attention, per-layer remat, adafactor
+    (factored moments — Adam's 8 GB of f32 moments don't fit beside
+    3.8 GB of f32 params in 16 GB HBM); the long-context rung trains
+    the SAME architecture at T=8192 (16x the attention work per token,
+    where causal block skipping and the flash kernel earn their keep).
+    Off-TPU: tiny configs keep the script smoke-runnable."""
+    from edl_tpu.models import llama
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        lcfg = llama.LlamaConfig(
+            vocab=32768,
+            d_model=2048,
+            n_layers=16,
+            n_heads=16,
+            n_kv_heads=8,
+            d_ff=6144,
+            dtype=jnp.bfloat16,
+            use_flash=True,
+            remat=True,
+        )
+        lt, ladder = 2048, (16, 8, 4, 2)
+        long_t, long_ladder = 8192, (4, 2, 1)
+        lsteps, lreps = 2, 4  # fused steps/dispatch, dispatches/loop
+    else:  # smoke config: exercise the same code path cheaply
+        lcfg = llama.LlamaConfig(
+            vocab=1024,
+            d_model=128,
+            n_layers=2,
+            n_heads=4,
+            n_kv_heads=2,
+            d_ff=384,
+            dtype=jnp.float32,
+            remat=True,
+        )
+        lt, ladder = 256, (2,)
+        long_t, long_ladder = 512, (1,)
+        lsteps, lreps = 2, 2
+
+    ltok_rate, used_batch, state_gb = _llama_measure(
+        lcfg, lt, ladder, lsteps, lreps, n_dev, plan, mesh, rng
+    )
+    long_rate, long_batch, _ = _llama_measure(
+        lcfg, long_t, long_ladder, lsteps, max(lreps // 2, 1),
+        n_dev, plan, mesh, rng,
+    )
+
     peak = _peak_flops(jax.devices()[0])
     fpt = llama.train_flops_per_token(lcfg, lt)
+    long_fpt = llama.train_flops_per_token(lcfg, long_t)
     return {
         "llama_tokens_per_sec_per_chip": round(ltok_rate, 1),
         "mfu": round(ltok_rate * fpt / peak, 4) if on_tpu else 0.0,
@@ -178,8 +194,11 @@ def _llama_flagship_bench(n_dev, plan, mesh, rng) -> dict:
             f"v{lcfg.vocab}/T{lt}/b{used_batch}"
         ),
         "llama_flops_per_token": round(fpt / 1e6, 1),  # MFLOPs
+        "llama_long_tokens_per_sec_per_chip": round(long_rate, 1),
+        "long_mfu": round(long_rate * long_fpt / peak, 4) if on_tpu else 0.0,
+        "llama_long_config": f"T{long_t}/b{long_batch}",
         "peak_tflops": round(peak / 1e12, 1),
-        **reshard_metrics,
+        "flagship_state_gb": round(state_gb, 2),
     }
 
 

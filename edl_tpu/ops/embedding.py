@@ -182,7 +182,7 @@ def sharded_embedding_lookup(
     size. ids int32, any shape, sharded ``ids_pspec`` (default
     replicated). Returns [*ids.shape, E] sharded like the ids.
     """
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     n = mesh.shape[vocab_axis]
@@ -207,5 +207,5 @@ def sharded_embedding_lookup(
         mesh=mesh,
         in_specs=(P(vocab_axis, None), ids_pspec),
         out_specs=out_pspec,
-        check_rep=False,
+        check_vma=False,
     )(table, ids)

@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 
 def _block_attn(q, k, v, scale, mask):
@@ -110,7 +110,7 @@ def ring_attention(
         mesh=mesh,
         in_specs=(bspec, bspec, bspec),
         out_specs=bspec,
-        check_rep=False,
+        check_vma=False,
     )(q, k, v)
 
 

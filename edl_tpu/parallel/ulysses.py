@@ -22,8 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-# the experimental module still accepts check_rep; jax.shard_map does not
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from edl_tpu.parallel.ring_attention import reference_attention
 
@@ -69,5 +68,5 @@ def ulysses_attention(
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        check_rep=False,
+        check_vma=False,
     )(q, k, v)
