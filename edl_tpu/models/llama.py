@@ -58,6 +58,14 @@ class LlamaConfig:
     #   "dots": save every weight-matmul output (near-zero recompute,
     #           most HBM — jax dots_with_no_batch_dims_saveable)
     remat_policy: str = "full"
+    # sequence/context parallelism implementation when the mesh plan has
+    # an sp axis: "ring" (ppermute neighbor exchange, scales past the
+    # head count) or "ulysses" (two all-to-alls, full-sequence attention
+    # on H/sp heads). Ignored when sp == 1.
+    sp_impl: str = "ring"
+    # GPipe microbatch count when the plan has a pp axis (0 = one
+    # microbatch per stage). Bubble fraction (pp-1)/(n_micro+pp-1).
+    pp_microbatches: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -125,6 +133,10 @@ def param_pspecs(cfg: LlamaConfig, plan: MeshPlan) -> Dict:
     parallel/sharding.py does)."""
     tp = "tp" if plan.axis_size("tp") > 1 else None
     fs = "fsdp" if plan.axis_size("fsdp") > 1 else None
+    # pipeline stages: the scan-stacked layer axis shards over pp, so
+    # each stage's devices hold only their own layers at rest; the
+    # pipeline shard_map gathers the fs/tp dims per step (ZeRO-style)
+    pp = "pp" if plan.axis_size("pp") > 1 else None
     d, h, kv, hd, ff, L, V = (
         cfg.d_model,
         cfg.n_heads,
@@ -143,15 +155,15 @@ def param_pspecs(cfg: LlamaConfig, plan: MeshPlan) -> Dict:
     return {
         "embed": fit((V, d), tp, fs),
         "layers": {
-            "ln1": P(None, None),
-            "wq": fit((L, d, h * hd), None, fs, tp),
-            "wk": fit((L, d, kv * hd), None, fs, tp),
-            "wv": fit((L, d, kv * hd), None, fs, tp),
-            "wo": fit((L, h * hd, d), None, tp, fs),
-            "ln2": P(None, None),
-            "w1": fit((L, d, ff), None, fs, tp),
-            "w3": fit((L, d, ff), None, fs, tp),
-            "w2": fit((L, ff, d), None, tp, fs),
+            "ln1": fit((L, d), pp, None),
+            "wq": fit((L, d, h * hd), pp, fs, tp),
+            "wk": fit((L, d, kv * hd), pp, fs, tp),
+            "wv": fit((L, d, kv * hd), pp, fs, tp),
+            "wo": fit((L, h * hd, d), pp, tp, fs),
+            "ln2": fit((L, d), pp, None),
+            "w1": fit((L, d, ff), pp, fs, tp),
+            "w3": fit((L, d, ff), pp, fs, tp),
+            "w2": fit((L, ff, d), pp, tp, fs),
         },
         "ln_f": P(None),
         "lm_head": fit((d, V), fs, tp),
@@ -175,10 +187,34 @@ def _rope(x: jnp.ndarray, theta: float) -> jnp.ndarray:
 
 
 def attention(
-    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, cfg: LlamaConfig
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    cfg: LlamaConfig,
+    mesh=None,
+    sp: int = 1,
 ) -> jnp.ndarray:
-    """Causal GQA attention. q [B,T,H,hd]; k,v [B,T,KV,hd]."""
+    """Causal GQA attention. q [B,T,H,hd]; k,v [B,T,KV,hd].
+
+    With ``sp > 1`` the sequence dim arrives sharded over the mesh's
+    ``sp`` axis and attention goes through ring attention (ppermute
+    K/V rotation) or Ulysses (head/sequence all-to-all) per
+    ``cfg.sp_impl`` — the long-context path (SURVEY §5)."""
     b, t, h, hd = q.shape
+    if sp > 1:
+        if mesh is None:
+            raise ValueError("sp attention needs the mesh")
+        # both sp kernels are GQA-aware: K/V travel the collectives at
+        # kv-head width and expand inside the local block compute
+        if cfg.sp_impl == "ring":
+            from edl_tpu.parallel.ring_attention import ring_attention
+
+            return ring_attention(q, k, v, mesh, axis="sp", causal=True)
+        elif cfg.sp_impl == "ulysses":
+            from edl_tpu.parallel.ulysses import ulysses_attention
+
+            return ulysses_attention(q, k, v, mesh, axis="sp", causal=True)
+        raise ValueError(f"unknown sp_impl {cfg.sp_impl!r}")
     if cfg.use_flash:
         from edl_tpu.ops.flash_attention import attention_auto, flash_supported
 
@@ -196,7 +232,9 @@ def attention(
     return jnp.einsum("bhts,bshd->bthd", probs, v)
 
 
-def _layer(cfg: LlamaConfig, x: jnp.ndarray, lp: Dict) -> jnp.ndarray:
+def _layer(
+    cfg: LlamaConfig, x: jnp.ndarray, lp: Dict, mesh=None, sp: int = 1
+) -> jnp.ndarray:
     b, t, d = x.shape
     h, kv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     dt = x.dtype
@@ -206,7 +244,7 @@ def _layer(cfg: LlamaConfig, x: jnp.ndarray, lp: Dict) -> jnp.ndarray:
     k = (a @ lp["wk"].astype(dt)).reshape(b, t, kv, hd)
     v = (a @ lp["wv"].astype(dt)).reshape(b, t, kv, hd)
     q, k = _rope(q, cfg.rope_theta), _rope(k, cfg.rope_theta)
-    o = attention(q, k, v, cfg).reshape(b, t, h * hd)
+    o = attention(q, k, v, cfg, mesh=mesh, sp=sp).reshape(b, t, h * hd)
     x = x + o @ lp["wo"].astype(dt)
     # mlp block (SwiGLU)
     m = _rmsnorm(x, lp["ln2"], cfg.norm_eps)
@@ -215,37 +253,115 @@ def _layer(cfg: LlamaConfig, x: jnp.ndarray, lp: Dict) -> jnp.ndarray:
     return x + (gate * up) @ lp["w2"].astype(dt)
 
 
-def forward(params: Dict, tokens: jnp.ndarray, cfg: LlamaConfig) -> jnp.ndarray:
-    """tokens [B, T] int32 → logits [B, T, vocab]."""
+def _remat_policy(cfg: LlamaConfig):
+    """The remat FLOPs/HBM dial (see LlamaConfig.remat_policy)."""
+    if cfg.remat_policy == "mlp":
+        return jax.checkpoint_policies.save_only_these_names(
+            "mlp_gate", "mlp_up"
+        )
+    if cfg.remat_policy == "attn":
+        if not cfg.use_flash:
+            raise ValueError(
+                'remat_policy="attn" saves the flash kernel\'s named '
+                "residuals; without use_flash there is nothing to "
+                "save and the policy would silently degrade to full "
+                "rematerialization"
+            )
+        return jax.checkpoint_policies.save_only_these_names(
+            "flash_out", "flash_lse"
+        )
+    if cfg.remat_policy == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    if cfg.remat_policy == "full":
+        return None
+    raise ValueError(f"unknown remat_policy {cfg.remat_policy!r}")
+
+
+def forward(
+    params: Dict,
+    tokens: jnp.ndarray,
+    cfg: LlamaConfig,
+    mesh=None,
+    plan: Optional[MeshPlan] = None,
+) -> jnp.ndarray:
+    """tokens [B, T] int32 → logits [B, T, vocab].
+
+    ``plan``/``mesh`` activate the parallel strategies beyond what GSPMD
+    infers from param shardings alone:
+
+    - ``sp > 1``: activations are sequence-sharded right after the
+      embedding (``plan.sequence_pspec``) and attention runs ring or
+      Ulysses over the sp axis — long-context training where no single
+      device ever holds a full-sequence activation.
+    - ``pp > 1``: the scan-stacked layer axis splits into pp stages
+      driven by the GPipe schedule (``parallel.pipeline.pipeline_apply``)
+      with microbatched activations flowing over ppermute.
+    """
+    sp = plan.axis_size("sp") if plan is not None else 1
+    pp = plan.axis_size("pp") if plan is not None else 1
+    if (sp > 1 or pp > 1) and mesh is None:
+        raise ValueError("sp/pp forward needs the mesh")
+    if sp > 1 and pp > 1:
+        # ring/ulysses attention is itself a shard_map; nesting it inside
+        # the pipeline shard_map is not supported by jax
+        raise ValueError("sp and pp cannot be combined in one llama mesh")
+    if sp > 1 and cfg.remat and cfg.remat_policy == "attn":
+        # the sp paths never run the flash kernel, so the flash_out /
+        # flash_lse names the policy saves would not exist — the policy
+        # would silently degrade to full remat (the failure its
+        # use_flash guard documents)
+        raise ValueError(
+            'remat_policy="attn" requires the flash kernel, which the '
+            "sp (ring/Ulysses) attention paths do not use"
+        )
     x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
+    if sp > 1:
+        if tokens.shape[1] % sp:
+            raise ValueError(
+                f"sequence {tokens.shape[1]} not divisible by sp={sp}"
+            )
+        x = jax.lax.with_sharding_constraint(
+            x, plan.sequence_sharding(mesh, rank=3)
+        )
 
     def body(carry, lp):
-        return _layer(cfg, carry, lp), None
+        return _layer(cfg, carry, lp, mesh=mesh, sp=sp), None
 
     if cfg.remat:
-        if cfg.remat_policy == "mlp":
-            policy = jax.checkpoint_policies.save_only_these_names(
-                "mlp_gate", "mlp_up"
+        body = jax.checkpoint(body, policy=_remat_policy(cfg))
+
+    if pp > 1:
+        from edl_tpu.parallel.pipeline import pipeline_apply
+
+        L, b = cfg.n_layers, x.shape[0]
+        if L % pp:
+            raise ValueError(f"n_layers {L} not divisible by pp={pp}")
+        n_micro = cfg.pp_microbatches or pp
+        if b % n_micro:
+            raise ValueError(f"batch {b} not divisible by n_micro={n_micro}")
+        shards = plan.batch_shards()
+        if (b // n_micro) % shards:
+            raise ValueError(
+                f"microbatch rows {b // n_micro} do not divide over the "
+                f"{shards} data shards (dp×fsdp) — lower pp_microbatches "
+                f"or raise the batch"
             )
-        elif cfg.remat_policy == "attn":
-            if not cfg.use_flash:
-                raise ValueError(
-                    'remat_policy="attn" saves the flash kernel\'s named '
-                    "residuals; without use_flash there is nothing to "
-                    "save and the policy would silently degrade to full "
-                    "rematerialization"
-                )
-            policy = jax.checkpoint_policies.save_only_these_names(
-                "flash_out", "flash_lse"
-            )
-        elif cfg.remat_policy == "dots":
-            policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-        elif cfg.remat_policy == "full":
-            policy = None
-        else:
-            raise ValueError(f"unknown remat_policy {cfg.remat_policy!r}")
-        body = jax.checkpoint(body, policy=policy)
-    x, _ = jax.lax.scan(body, x, params["layers"])
+        stage_params = jax.tree_util.tree_map(
+            lambda l: l.reshape((pp, L // pp) + l.shape[1:]), params["layers"]
+        )
+
+        def stage_fn(sp_params, xm):
+            y, _ = jax.lax.scan(body, xm, sp_params)
+            return y
+
+        xm = x.reshape((n_micro, b // n_micro) + x.shape[1:])
+        xm = pipeline_apply(
+            stage_fn, stage_params, xm, mesh,
+            data_axes=plan.batch_axes(),
+        )
+        x = xm.reshape((b,) + xm.shape[2:])
+    else:
+        x, _ = jax.lax.scan(body, x, params["layers"])
     x = _rmsnorm(x, params["ln_f"], cfg.norm_eps)
     return (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
 
@@ -267,18 +383,31 @@ def train_flops_per_token(cfg: LlamaConfig, seq: int) -> float:
     return 6.0 * n_matmul + attn
 
 
-def make_loss_fn(cfg: LlamaConfig):
-    """Next-token cross entropy; batch = {tokens [B, T+1]}."""
+def make_loss_fn(cfg: LlamaConfig, plan: Optional[MeshPlan] = None, mesh=None):
+    """Next-token cross entropy; batch = {tokens [B, T+1]}.
+
+    ``plan``/``mesh`` flow through to :func:`forward` to activate sp/pp
+    (the trainable-strategy contract: the worker runtime builds the loss
+    via ``Workload.make_loss(plan, mesh)`` after every rendezvous, so
+    the program matches the current elastic mesh). The [B, T+1] token
+    feed stays batch-sharded — int32 tokens are negligible bytes; the
+    sp sharding starts at the embedding output inside ``forward``."""
 
     def loss_fn(params, batch):
         tokens = batch["tokens"]
-        logits = forward(params, tokens[:, :-1], cfg)
-        targets = tokens[:, 1:]
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        logits = forward(params, inputs, cfg, mesh=mesh, plan=plan)
         # fused CE (logsumexp - target logit): two reductions over the
         # vocab axis instead of materializing the full [B,T,V]
         # log-softmax (4+ GB of f32 at the bench config)
         import optax
 
+        if plan is not None and plan.axis_size("sp") > 1:
+            # align targets with the sequence-sharded logits so the CE
+            # stays local to each sp shard (the mean is global)
+            targets = jax.lax.with_sharding_constraint(
+                targets, plan.sequence_sharding(mesh, rank=2)
+            )
         return jnp.mean(
             optax.softmax_cross_entropy_with_integer_labels(logits, targets)
         )

@@ -173,6 +173,20 @@ class MeshPlan:
     def batch_sharding(self, mesh: Mesh) -> NamedSharding:
         return NamedSharding(mesh, self.batch_pspec())
 
+    def sequence_pspec(self, rank: int = 2) -> P:
+        """[B, T, ...] activations: batch dim over the batch axes, the
+        sequence dim over ``sp`` (context parallelism), rest replicated.
+        This is the activation layout of an sp-sharded training step —
+        models apply it via ``with_sharding_constraint`` right after the
+        embedding lookup so every downstream op (and the ring/Ulysses
+        attention shard_map) sees sequence-sharded activations."""
+        ba = self.batch_axes()
+        sp = "sp" if self.axis_size("sp") > 1 else None
+        return P(ba if ba else None, sp, *(None,) * (rank - 2))
+
+    def sequence_sharding(self, mesh: Mesh, rank: int = 2) -> NamedSharding:
+        return NamedSharding(mesh, self.sequence_pspec(rank))
+
     def replicated(self, mesh: Mesh) -> NamedSharding:
         return NamedSharding(mesh, P())
 

@@ -24,13 +24,18 @@ def pipeline_apply(
     x: jnp.ndarray,
     mesh: Mesh,
     axis: str = "pp",
+    data_axes: tuple = (),
 ):
     """Run ``x`` through n_stages of ``stage_fn`` spread over the pp axis.
 
     stage_params : pytree whose leaves have leading dim n_stages
                    (sharded P(axis, ...)).
-    x : [n_micro, mb, ...] microbatched input (replicated over pp).
-    Returns [n_micro, mb, ...] outputs of the last stage (replicated).
+    x : [n_micro, mb, ...] microbatched input. With ``data_axes`` (e.g.
+        ``("dp", "fsdp")``) the mb dim stays sharded over those mesh
+        axes — each dp group runs its own pipeline on its own rows, so
+        pp composes with data parallelism without gathering the batch.
+    Returns [n_micro, mb, ...] outputs of the last stage, same sharding
+    as ``x`` (replicated over pp).
     """
     n = mesh.shape[axis]
     for leaf in jax.tree_util.tree_leaves(stage_params):
@@ -42,6 +47,8 @@ def pipeline_apply(
     pspec = jax.tree_util.tree_map(
         lambda l: P(axis, *(None,) * (l.ndim - 1)), stage_params
     )
+    da = tuple(a for a in data_axes if a in mesh.axis_names and mesh.shape[a] > 1)
+    xspec = P(None, da or None, *(None,) * (x.ndim - 2))
 
     def local(params, xm):
         # params leaves: [1, ...] (this device's stage); squeeze
@@ -82,7 +89,7 @@ def pipeline_apply(
     return shard_map(
         local,
         mesh=mesh,
-        in_specs=(pspec, P()),
-        out_specs=P(),
+        in_specs=(pspec, xspec),
+        out_specs=xspec,
         check_vma=False,
     )(stage_params, x)

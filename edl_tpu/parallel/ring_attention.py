@@ -22,9 +22,15 @@ from jax import shard_map
 
 
 def _block_attn(q, k, v, scale, mask):
-    """One blockwise score pass. q [B,t,H,d]; k,v [B,s,H,d];
-    mask [t,s] bool (True = attend). Returns (o_unnorm [B,t,H,d],
-    m [B,t,H] block max, l [B,t,H] block sum)."""
+    """One blockwise score pass. q [B,t,H,d]; k,v [B,s,KV,d] with
+    H = KV·groups (GQA: each KV head serves a group of query heads —
+    K/V travel the ring at KV width and only expand here, inside the
+    block kernel); mask [t,s] bool (True = attend). Returns
+    (o_unnorm [B,t,H,d], m [B,t,H] block max, l [B,t,H] block sum)."""
+    h, kv = q.shape[2], k.shape[2]
+    if h != kv:
+        k = jnp.repeat(k, h // kv, axis=2)
+        v = jnp.repeat(v, h // kv, axis=2)
     s = jnp.einsum("bthd,bshd->bhts", q, k) * scale
     s = jnp.where(mask[None, None], s, -jnp.inf)
     m = jnp.max(s, axis=-1)  # [B,H,t]

@@ -44,22 +44,50 @@ def ulysses_attention(
     n = mesh.shape[axis]
     if q.shape[2] % n:
         raise ValueError(f"n_heads={q.shape[2]} not divisible by {axis}={n}")
+    # GQA: K/V ride the all-to-all at their (smaller) kv-head width and
+    # expand only locally, after the exchange — when kv_heads divides
+    # the axis; otherwise expand up front (correct, more bytes)
+    kv_heads = k.shape[2]
+    if kv_heads % n and q.shape[2] != kv_heads:
+        rep = q.shape[2] // kv_heads
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     # batch dim keeps whatever data-axis sharding it has (as ring_attention)
     other = tuple(a for a in mesh.axis_names if a != axis)
     spec = P(tuple(a for a in other if a in ("dp", "fsdp")) or None, axis, None, None)
 
     def local(q, k, v):
-        # [B, S/n, H, d] --all-to-all--> [B, S, H/n, d]
+        out_dtype = q.dtype
+
+        # [B, S/n, H, d] --all-to-all--> [B, S, H/n, d]  (activation-dtype
+        # bytes on the wire; the f32 upcast happens after the exchange)
         def scatter_heads(x):
             return jax.lax.all_to_all(
                 x, axis, split_axis=2, concat_axis=1, tiled=True
             )
 
-        # full-sequence attention on the local head shard (the unsharded
-        # oracle is exactly the right kernel here)
-        o = reference_attention(
-            scatter_heads(q), scatter_heads(k), scatter_heads(v), causal=causal
-        )
+        q, k, v = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+        s_global = q.shape[1]
+        from edl_tpu.ops.flash_attention import attention_auto, flash_supported
+
+        if jax.devices()[0].platform == "tpu" and flash_supported(s_global):
+            # full-sequence attention on the local head shard via the
+            # blockwise pallas kernel (GQA-native, O(S) memory) — the
+            # whole point of Ulysses: any single-device kernel drops in
+            o = attention_auto(q, k, v, causal=causal)
+        else:
+            # oracle fallback (tests / unsupported lengths): f32
+            # softmax (the bf16-drift guard ring_attention documents),
+            # O(S^2) scores — fine at test scale only
+            if k.shape[2] != q.shape[2]:  # expand GQA groups
+                k = jnp.repeat(k, q.shape[2] // k.shape[2], axis=2)
+                v = jnp.repeat(v, q.shape[2] // v.shape[2], axis=2)
+            o = reference_attention(
+                q.astype(jnp.float32),
+                k.astype(jnp.float32),
+                v.astype(jnp.float32),
+                causal=causal,
+            ).astype(out_dtype)
         # [B, S, H/n, d] --all-to-all--> [B, S/n, H, d]
         return jax.lax.all_to_all(o, axis, split_axis=1, concat_axis=2, tiled=True)
 

@@ -115,8 +115,15 @@ class ElasticTrainer:
         checkpoint_dir: Optional[str] = None,
         checkpoint_every_steps: int = 0,
         sync_every: int = 1,
+        make_loss: Optional[Callable] = None,
     ):
         self.loss_fn = loss_fn
+        # mesh-aware loss factory ``(plan, mesh) -> loss_fn``, re-invoked
+        # at every (re)build — required for strategies whose program
+        # depends on the mesh layout (llama sp ring/Ulysses attention,
+        # pp GPipe schedule), mirroring Workload.make_loss in the
+        # process runtime. When given, ``loss_fn`` may be None.
+        self.make_loss = make_loss
         self.tx = tx
         self.mesh_spec = mesh_spec or MeshSpec()
         self.chips_per_worker = chips_per_worker
@@ -224,11 +231,16 @@ class ElasticTrainer:
             if callable(self.param_pspecs)
             else self.param_pspecs
         )
+        loss = (
+            self.make_loss(self.plan, self.mesh)
+            if self.make_loss is not None
+            else self.loss_fn
+        )
         self._step_fn = make_train_step(
-            self.loss_fn, self.tx, self.plan, self.mesh, self._pspecs
+            loss, self.tx, self.plan, self.mesh, self._pspecs
         )
         self._stepper = (
-            LocalSyncStepper(self.loss_fn, self.tx, self.plan, self.mesh)
+            LocalSyncStepper(loss, self.tx, self.plan, self.mesh)
             if self.sync_every > 1
             else None
         )

@@ -54,6 +54,7 @@ import signal
 import sys
 import threading
 import time
+import dataclasses
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Optional
 
@@ -174,6 +175,15 @@ class Workload:
     loss_fn: Callable
     batch_fn: Callable[[int, int], Dict[str, np.ndarray]]
     pspecs: Optional[Callable[[Any], Any]] = None
+    # mesh-aware loss factory (plan, mesh) -> loss_fn. Models whose
+    # program depends on the mesh layout (llama's sp ring attention /
+    # pp pipeline schedule) provide this; it is re-invoked after every
+    # rendezvous so the compiled step matches the current elastic mesh.
+    # When absent, the static loss_fn is used as-is.
+    make_loss: Optional[Callable[[Any, Any], Callable]] = None
+
+    def loss_for(self, plan, mesh) -> Callable:
+        return self.make_loss(plan, mesh) if self.make_loss else self.loss_fn
 
 
 def _linreg_workload(cfg: WorkerConfig) -> Workload:
@@ -235,6 +245,9 @@ def _llama_workload(cfg: WorkerConfig) -> Workload:
         llama.make_loss_fn(mcfg),
         batch_fn,
         pspecs=lambda plan: llama.param_pspecs(mcfg, plan),
+        # sp/pp are mesh-layout-dependent (ring attention shard_map /
+        # GPipe schedule) — rebuild the loss per rendezvous
+        make_loss=lambda plan, mesh: llama.make_loss_fn(mcfg, plan, mesh),
     )
 
 
@@ -782,9 +795,7 @@ class ElasticWorker:
             from edl_tpu.runtime.shards import FileShardSource
 
             source = FileShardSource(cfg.data_dir)
-            wl = Workload(
-                wl.init_params, wl.loss_fn, source.fetch_range, wl.pspecs
-            )
+            wl = dataclasses.replace(wl, batch_fn=source.fetch_range)
             cfg.n_samples = source.n_samples
             log.info(
                 "dataset attached", dir=cfg.data_dir, n_samples=cfg.n_samples
@@ -886,17 +897,18 @@ class ElasticWorker:
                 )
             self._local_rows = rows // world
             state, pspecs = self._restore_state(wl, tx, plan, mesh)
+            loss_fn = wl.loss_for(plan, mesh)
             # donate=False: after a failed collective (peer crash) the
             # pre-step buffers must still be alive to recover from.
             step = make_train_step(
-                wl.loss_fn, tx, plan, mesh, param_pspecs=pspecs, donate=False
+                loss_fn, tx, plan, mesh, param_pspecs=pspecs, donate=False
             )
             stepper = None
             if cfg.sync_every > 1:
                 from edl_tpu.train.trainer import LocalSyncStepper
 
                 stepper = LocalSyncStepper(
-                    wl.loss_fn, tx, plan, mesh, donate=False
+                    loss_fn, tx, plan, mesh, donate=False
                 )
                 state = stepper.localize(state)
 

@@ -24,6 +24,12 @@ def main() -> int:
     ap.add_argument("--steps", type=int, default=6)
     ap.add_argument("--seq", type=int, default=128)
     ap.add_argument("--per-shard-batch", type=int, default=2)
+    ap.add_argument(
+        "--mesh",
+        default="",
+        help='MeshPlan.parse override, e.g. "sp=2,dp" (ring attention) '
+        'or "pp=2,dp" (GPipe) — default: the job.yaml mesh block',
+    )
     args = ap.parse_args()
 
     force_virtual_cpu(args.devices)
@@ -42,11 +48,13 @@ def main() -> int:
         shard_state,
     )
 
-    job = TrainingJob.from_yaml_file(
-        os.path.join(os.path.dirname(__file__), "job.yaml")
-    )
-    axes = job.spec.mesh.axis_sizes()
-    plan = MeshPlan.create(**axes)
+    if args.mesh:
+        plan = MeshPlan.parse(args.mesh, args.devices)
+    else:
+        job = TrainingJob.from_yaml_file(
+            os.path.join(os.path.dirname(__file__), "job.yaml")
+        )
+        plan = MeshPlan.create(**job.spec.mesh.axis_sizes())
     mesh = plan.build(jax.devices()[: args.devices])
     print(f"mesh: {plan.describe()}")
 
@@ -54,8 +62,12 @@ def main() -> int:
     params = llama.init_params(jax.random.PRNGKey(0), cfg)
     pspecs = llama.param_pspecs(cfg, plan)
     tx = optax.adamw(3e-4)
+    # mesh-aware loss: activates ring/Ulysses attention on an sp axis
+    # and the GPipe schedule on a pp axis
     state = shard_state(TrainState.create(params, tx), plan, mesh, pspecs)
-    step = make_train_step(llama.make_loss_fn(cfg), tx, plan, mesh, pspecs)
+    step = make_train_step(
+        llama.make_loss_fn(cfg, plan, mesh), tx, plan, mesh, pspecs
+    )
 
     rng = np.random.RandomState(0)
     shards = plan.batch_shards()

@@ -80,6 +80,149 @@ def test_tp_matches_unsharded(cpu_devices):
     np.testing.assert_allclose(l_tp, l_dp, rtol=1e-4, atol=1e-5)
 
 
+def _loss_curve(plan, cfg=None, n_batches=3, **cfg_overrides):
+    """Train the tiny llama for a few SGD steps under ``plan`` and
+    return the loss curve — the parity harness for every strategy mesh
+    (a layout choice must not change the math)."""
+    import dataclasses
+
+    cfg = cfg or llama.LlamaConfig.tiny()
+    if cfg_overrides:
+        cfg = dataclasses.replace(cfg, **cfg_overrides)
+    batches = [
+        llama.synthetic_tokens(np.random.RandomState(i), 8, 16, cfg.vocab)
+        for i in range(n_batches)
+    ]
+    mesh = plan.build()
+    params = llama.init_params(jax.random.PRNGKey(1), cfg)
+    tx = optax.sgd(1e-2)
+    pspecs = llama.param_pspecs(cfg, plan)
+    state = shard_state(TrainState.create(params, tx), plan, mesh, pspecs)
+    step = make_train_step(
+        llama.make_loss_fn(cfg, plan, mesh), tx, plan, mesh, pspecs
+    )
+    out = []
+    for b in batches:
+        state, m = step(state, global_batch(b, plan, mesh))
+        out.append(float(m["loss"]))
+    return out
+
+
+def test_sp_ring_matches_dp(cpu_devices):
+    """sp=2 (ring attention) — the long-context strategy as a TRAINABLE
+    mesh axis: full train steps, loss == dp-only loss (SURVEY §2.5 SP,
+    VERDICT r2 #1a)."""
+    l_dp = _loss_curve(MeshPlan.data_parallel(8))
+    l_sp = _loss_curve(MeshPlan.create(dp=4, sp=2))
+    np.testing.assert_allclose(l_sp, l_dp, rtol=1e-4, atol=1e-5)
+
+
+def test_sp_ulysses_matches_dp(cpu_devices):
+    l_dp = _loss_curve(MeshPlan.data_parallel(8))
+    l_ul = _loss_curve(MeshPlan.create(dp=4, sp=2), sp_impl="ulysses")
+    np.testing.assert_allclose(l_ul, l_dp, rtol=1e-4, atol=1e-5)
+
+
+def test_sp_with_fsdp_matches_dp(cpu_devices):
+    """sp composes with fsdp+remat (the long-context production mesh)."""
+    l_dp = _loss_curve(MeshPlan.data_parallel(8))
+    l_mix = _loss_curve(MeshPlan.create(fsdp=2, sp=2, dp=2), remat=True)
+    np.testing.assert_allclose(l_mix, l_dp, rtol=1e-4, atol=1e-5)
+
+
+def test_pp_matches_dp(cpu_devices):
+    """pp=2 (GPipe over ppermute) as a TRAINABLE mesh axis (VERDICT r2
+    #1b): full train steps through pipeline_apply, loss == dp loss."""
+    l_dp = _loss_curve(MeshPlan.data_parallel(8))
+    l_pp = _loss_curve(MeshPlan.create(dp=4, pp=2))
+    np.testing.assert_allclose(l_pp, l_dp, rtol=1e-4, atol=1e-5)
+    # more microbatches than stages (the realistic bubble regime)
+    l_pp4 = _loss_curve(MeshPlan.create(dp=2, pp=2), pp_microbatches=4)
+    np.testing.assert_allclose(l_pp4, l_dp, rtol=1e-4, atol=1e-5)
+
+
+def test_pp_shards_layer_axis_and_moments(cpu_devices):
+    """With a pp axis the scan-stacked layer dim is REALLY split across
+    stages (each device holds only its stage's layers), and Adam
+    moments follow."""
+    cfg = llama.LlamaConfig.tiny()
+    plan = MeshPlan.create(dp=4, pp=2)
+    mesh = plan.build()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    pspecs = llama.param_pspecs(cfg, plan)
+    tx = optax.adam(1e-3)
+    state = shard_state(TrainState.create(params, tx), plan, mesh, pspecs)
+    wq = state.params["layers"]["wq"]
+    per_stage = (
+        cfg.n_layers // 2,
+        cfg.d_model,
+        cfg.n_heads * cfg.head_dim,
+    )
+    assert {s.data.shape for s in wq.addressable_shards} == {per_stage}
+    mu_wq = state.opt_state[0].mu["layers"]["wq"]
+    assert {s.data.shape for s in mu_wq.addressable_shards} == {per_stage}
+
+
+def test_sp_sequence_shards_activations(cpu_devices):
+    """The sp program really sequence-shards the compute: logits come
+    out split over sp on the T dim (no device saw the full sequence)."""
+    cfg = llama.LlamaConfig.tiny()
+    plan = MeshPlan.create(dp=2, sp=4)
+    mesh = plan.build()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    toks = np.arange(4 * 16, dtype=np.int32).reshape(4, 16) % cfg.vocab
+
+    fwd = jax.jit(
+        lambda p, t: llama.forward(p, t, cfg, mesh=mesh, plan=plan)
+    )
+    logits = fwd(params, jnp.asarray(toks))
+    spec = logits.sharding.spec
+    assert spec[1] == "sp", spec
+    # and the math still matches the unsharded oracle
+    ref = llama.forward(params, jnp.asarray(toks), cfg)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref), atol=2e-4)
+
+
+def test_sp_pp_combination_rejected(cpu_devices):
+    cfg = llama.LlamaConfig.tiny()
+    plan = MeshPlan.create(dp=2, sp=2, pp=2)
+    mesh = plan.build()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    toks = jnp.zeros((4, 16), jnp.int32)
+    import pytest
+
+    with pytest.raises(ValueError, match="sp and pp"):
+        llama.forward(params, toks, cfg, mesh=mesh, plan=plan)
+
+
+def test_llama_elastic_sp_reshard(cpu_devices):
+    """sp pinned in the in-process elastic runtime: the mesh-aware loss
+    factory rebuilds the ring-attention program at every reshard while
+    dp absorbs the worker change."""
+    cfg = llama.LlamaConfig.tiny()
+    tr = ElasticTrainer(
+        None,
+        optax.adam(1e-3),
+        mesh_spec=MeshSpec(sp=2),
+        chips_per_worker=2,
+        per_chip_batch=4,
+        param_pspecs=lambda plan: llama.param_pspecs(cfg, plan),
+        make_loss=lambda plan, mesh: llama.make_loss_fn(cfg, plan, mesh),
+    )
+    tr.start(llama.init_params(jax.random.PRNGKey(0), cfg), n_workers=2)
+    rng = np.random.RandomState(0)
+
+    def data(bs):
+        return llama.synthetic_tokens(rng, bs, 16, cfg.vocab)
+
+    tr.train_steps(data, 3)
+    tr.request_rescale(4)
+    tr.train_steps(data, 3)
+    assert tr.plan.describe() == {"dp": 4, "sp": 2}
+    assert len(tr.report.reshards) == 1
+    assert int(tr.state.step) == 6
+
+
 def test_llama_elastic_fsdp_reshard(cpu_devices):
     # The BASELINE headline config in miniature: elastic FSDP llama.
     cfg = llama.LlamaConfig.tiny()
