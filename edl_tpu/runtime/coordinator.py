@@ -104,12 +104,14 @@ def _parse_members(s: str) -> List[Member]:
 class NativeCoordinator:
     """ctypes wrapper over the C++ core (in-process mode)."""
 
-    def __init__(self, member_ttl_s: float = 10.0):
+    def __init__(self, member_ttl_s: float = 10.0, wal_path: str = ""):
         if not ensure_native_built():
             raise RuntimeError("native coordinator unavailable")
         lib = ctypes.CDLL(_LIB_PATH)
         lib.edl_coord_new.restype = ctypes.c_void_p
         lib.edl_coord_new.argtypes = [ctypes.c_double]
+        lib.edl_coord_new_wal.restype = ctypes.c_void_p
+        lib.edl_coord_new_wal.argtypes = [ctypes.c_double, ctypes.c_char_p]
         lib.edl_coord_free.argtypes = [ctypes.c_void_p]
         lib.edl_kv_put.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p]
         lib.edl_kv_get.restype = ctypes.c_longlong
@@ -164,7 +166,16 @@ class NativeCoordinator:
         lib.edl_queue_done.argtypes = [ctypes.c_void_p]
         lib.edl_queue_stats.argtypes = [ctypes.c_void_p, ctypes.c_longlong * 5]
         self._lib = lib
-        self._h = lib.edl_coord_new(member_ttl_s)
+        # wal_path makes the coordinator durable: mutations append to a
+        # write-ahead log; a new instance on the same path replays it
+        if wal_path:
+            # preflight the path so an unwritable WAL raises here
+            # instead of running silently non-durable
+            with open(wal_path, "a"):
+                pass
+            self._h = lib.edl_coord_new_wal(member_ttl_s, wal_path.encode())
+        else:
+            self._h = lib.edl_coord_new(member_ttl_s)
 
     def close(self):
         if self._h:
@@ -261,28 +272,76 @@ class NativeCoordinator:
 
 
 class CoordinatorClient:
-    """TCP client for the edl-coordinator line protocol."""
+    """TCP client for the edl-coordinator line protocol.
 
-    def __init__(self, host: str, port: int, timeout_s: float = 10.0):
-        self._sock = socket.create_connection((host, port), timeout=timeout_s)
-        self._file = self._sock.makefile("rwb")
+    Survives coordinator restarts: a broken connection is re-dialed with
+    exponential backoff for up to ``reconnect_window_s`` and the command
+    re-issued (the WAL makes the restarted server resume with the same
+    state, so retried commands are safe: PUT/DEL/REG/BARRIER are
+    idempotent, a retried LEASE at worst leases a different task while
+    the first lease times out and redelivers, and a retried ACK/NACK
+    whose first attempt was applied returns False — callers already
+    treat that as "lease gone"). Set ``reconnect_window_s=0`` to fail
+    fast (the old behavior)."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        timeout_s: float = 10.0,
+        reconnect_window_s: float = 30.0,
+    ):
+        self._host = host
+        self._port = port
+        self._timeout_s = timeout_s
+        self._reconnect_window_s = reconnect_window_s
         self._lock = threading.Lock()
+        self._sock = None
+        self._file = None
+        self._connect()
+
+    def _connect(self) -> None:
+        self._sock = socket.create_connection(
+            (self._host, self._port), timeout=self._timeout_s
+        )
+        self._file = self._sock.makefile("rwb")
 
     def close(self) -> None:
         try:
-            self._file.close()
-            self._sock.close()
+            if self._file is not None:
+                self._file.close()
+            if self._sock is not None:
+                self._sock.close()
         except OSError:
             pass
+        self._sock = self._file = None
 
-    def _call(self, line: str) -> str:
-        with self._lock:
-            self._file.write(line.encode() + b"\n")
-            self._file.flush()
-            resp = self._file.readline()
+    def _roundtrip(self, line: str) -> str:
+        if self._sock is None:
+            self._connect()
+        self._file.write(line.encode() + b"\n")
+        self._file.flush()
+        resp = self._file.readline()
         if not resp:
             raise ConnectionError("coordinator closed connection")
         return resp.decode().rstrip("\n")
+
+    def _call(self, line: str) -> str:
+        with self._lock:
+            deadline = time.monotonic() + self._reconnect_window_s
+            backoff = 0.05
+            while True:
+                try:
+                    return self._roundtrip(line)
+                except (ConnectionError, OSError, socket.timeout) as e:
+                    self.close()
+                    if time.monotonic() >= deadline:
+                        raise ConnectionError(
+                            f"coordinator unreachable after "
+                            f"{self._reconnect_window_s:.0f}s: {e}"
+                        ) from e
+                    time.sleep(backoff)
+                    backoff = min(backoff * 2, 2.0)
 
     def ping(self) -> bool:
         return self._call("PING") == "PONG"
@@ -361,9 +420,16 @@ class CoordinatorClient:
 
 class CoordinatorServer:
     """Spawn/own an edl-coordinator process (per-job coordinator pod
-    analog)."""
+    analog). With ``wal_path`` the server is durable: :meth:`restart`
+    (or a crash + external respawn) resumes from the write-ahead log
+    with exact KV/membership/queue accounting — the etcd-durability
+    analog (reference: pkg/jobparser.go:167-184 runs etcd in the
+    master pod; docker/paddle_k8s:28-31 restarts the master against
+    it)."""
 
-    def __init__(self, port: int = 0, member_ttl_s: float = 10.0):
+    def __init__(
+        self, port: int = 0, member_ttl_s: float = 10.0, wal_path: str = ""
+    ):
         if not ensure_native_built():
             raise RuntimeError("native coordinator unavailable")
         if port == 0:
@@ -372,10 +438,20 @@ class CoordinatorServer:
             port = s.getsockname()[1]
             s.close()
         self.port = port
+        self.member_ttl_s = member_ttl_s
+        self.wal_path = wal_path
+        self._spawn()
+
+    def _spawn(self) -> None:
+        cmd = [
+            _BIN_PATH,
+            "--port", str(self.port),
+            "--member-ttl", str(self.member_ttl_s),
+        ]
+        if self.wal_path:
+            cmd += ["--wal", self.wal_path]
         self._proc = subprocess.Popen(
-            [_BIN_PATH, "--port", str(port), "--member-ttl", str(member_ttl_s)],
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT,
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT
         )
         line = self._proc.stdout.readline().decode()
         if "listening" not in line:
@@ -383,6 +459,21 @@ class CoordinatorServer:
 
     def client(self) -> CoordinatorClient:
         return CoordinatorClient("127.0.0.1", self.port)
+
+    def kill(self) -> None:
+        """Fault injection: SIGKILL the coordinator process (no
+        graceful shutdown, no flush beyond the per-mutation WAL
+        append)."""
+        if self._proc.poll() is None:
+            self._proc.kill()
+            self._proc.wait(timeout=5)
+
+    def restart(self) -> None:
+        """Respawn on the same port, recovering from the WAL (no-op
+        state without one). Clients built by :meth:`client` reconnect
+        automatically."""
+        self.kill()
+        self._spawn()
 
     def stop(self) -> None:
         if self._proc.poll() is None:

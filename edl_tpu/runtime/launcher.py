@@ -66,10 +66,32 @@ class ProcessJobLauncher:
     def __post_init__(self):
         os.makedirs(self.ckpt_dir, exist_ok=True)
         os.makedirs(self.log_dir, exist_ok=True)
-        self.server = CoordinatorServer(member_ttl_s=self.member_ttl_s)
+        # durable coordinator: the WAL lives in the job work dir, so a
+        # killed coordinator can be restarted with exact accounting.
+        # A launcher always starts a NEW job — drop any previous job's
+        # log (a stale WAL would replay its queue_inited/phase KV and
+        # the fresh job would "complete" without training).
+        wal_path = os.path.join(self.work_dir, "coordinator.wal")
+        if os.path.exists(wal_path):
+            os.remove(wal_path)
+        self.server = CoordinatorServer(
+            member_ttl_s=self.member_ttl_s, wal_path=wal_path
+        )
         self.client: CoordinatorClient = self.server.client()
         self.workers: List[WorkerProc] = []
         self._next_id = 0
+
+    # -- coordinator fault injection ----------------------------------------
+
+    def kill_coordinator(self) -> None:
+        """SIGKILL the coordinator process mid-job (the SPOF fault the
+        reference tolerates via etcd durability)."""
+        self.server.kill()
+
+    def restart_coordinator(self) -> None:
+        """Respawn the coordinator on the same port; it recovers from
+        the WAL and the workers' reconnecting clients resume."""
+        self.server.restart()
 
     @property
     def ckpt_dir(self) -> str:

@@ -11,6 +11,10 @@ using edl::Task;
 extern "C" {
 
 void* edl_coord_new(double member_ttl_s) { return new Coordinator(member_ttl_s); }
+// Durable variant: replay + append a write-ahead log at wal_path.
+void* edl_coord_new_wal(double member_ttl_s, const char* wal_path) {
+  return new Coordinator(member_ttl_s, wal_path ? wal_path : "");
+}
 void edl_coord_free(void* h) { delete static_cast<Coordinator*>(h); }
 
 // KV: get copies into caller buffer; returns value length or -1.

@@ -1,7 +1,46 @@
 #include "coordinator.h"
 
 #include <algorithm>
+#include <cerrno>
 #include <chrono>
+#include <cstring>
+#include <fstream>
+#include <sstream>
+
+namespace {
+
+// WAL token escaping: the log is line-and-space framed, but KV keys and
+// values are arbitrary client strings (only the TCP path is inherently
+// newline-free; the in-process ctypes path is not). Backslash-encode
+// the framing characters so replay can't mis-parse an embedded "\n" as
+// a fresh WAL op.
+std::string EscapeWal(const std::string& s, bool escape_space) {
+  std::string out;
+  out.reserve(s.size());
+  for (char c : s) {
+    if (c == '\\') out += "\\\\";
+    else if (c == '\n') out += "\\n";
+    else if (c == ' ' && escape_space) out += "\\_";
+    else out += c;
+  }
+  return out;
+}
+
+std::string UnescapeWal(const std::string& s) {
+  std::string out;
+  out.reserve(s.size());
+  for (size_t i = 0; i < s.size(); ++i) {
+    if (s[i] == '\\' && i + 1 < s.size()) {
+      char n = s[++i];
+      out += n == 'n' ? '\n' : n == '_' ? ' ' : n;
+    } else {
+      out += s[i];
+    }
+  }
+  return out;
+}
+
+}  // namespace
 
 namespace edl {
 
@@ -11,11 +50,164 @@ double Coordinator::Now() {
       .count();
 }
 
+// ------------------------------------------------------------------ WAL
+//
+// Line ops (space-separated; keys/worker names are space-free by the
+// same contract as the TCP protocol; KV values are rest-of-line):
+//   P <key> <value...>                    kv put
+//   D <key>                               kv del
+//   R <worker> <incarnation>              member (re)register
+//   L <worker>                            graceful leave
+//   X <w1> <w2> ...                       one expiry sweep (one epoch bump)
+//   B <name> <worker>                     barrier arrival
+//   Q <n> <chunk> <passes> <timeout> <maxfail>   queue init
+//   G <epoch>                             pass advance (epoch fill)
+//   T <id> <start> <end> <epoch> <fails> <worker>  lease granted
+//   O <id>                                lease timeout requeue
+//   A <id>                                ack
+//   N <id>                                nack
+//   W <worker>                            release all of worker's leases
+
+Coordinator::Coordinator(double member_ttl_s, const std::string& wal_path)
+    : member_ttl_s_(member_ttl_s) {
+  if (wal_path.empty()) return;
+  std::lock_guard<std::mutex> lock(mu_);
+  WalReplayLocked(wal_path);
+  // append mode: recovered state continues in the same log
+  wal_ = std::fopen(wal_path.c_str(), "a");
+  if (!wal_) {
+    // surface loudly: running silently non-durable is the exact data
+    // loss the WAL exists to prevent (callers preflight-open the path;
+    // this is the belt-and-braces diagnostic)
+    std::fprintf(stderr, "edl-coordinator: cannot open WAL %s: %s\n",
+                 wal_path.c_str(), std::strerror(errno));
+  }
+  // crash-window repair: the pass-advance "G" record is appended after
+  // the ack "A" that triggered it; a crash between the two replays to
+  // an empty todo_/leases_ mid-pass, which would hang Lease/QueueDone
+  // forever. Re-run the advance check here (wal_ is open: the G is
+  // logged this time).
+  if (queue_ready_ && todo_.empty() && leases_.empty()) AdvanceEpochLocked();
+}
+
+Coordinator::~Coordinator() {
+  if (wal_) std::fclose(wal_);
+}
+
+void Coordinator::WalAppendLocked(const std::string& line) {
+  if (!wal_ || replaying_) return;
+  std::fwrite(line.data(), 1, line.size(), wal_);
+  std::fputc('\n', wal_);
+  // flush to the OS on every mutation: survives SIGKILL of this
+  // process (page cache persists); a machine crash can lose the tail,
+  // which costs at most re-running un-acked tasks (at-least-once)
+  std::fflush(wal_);
+}
+
+void Coordinator::WalReplayLocked(const std::string& path) {
+  std::ifstream in(path);
+  if (!in.good()) return;
+  replaying_ = true;
+  double now = Now();
+  std::string line;
+  while (std::getline(in, line)) {
+    if (!line.empty()) WalApplyLocked(line, now);
+  }
+  replaying_ = false;
+}
+
+void Coordinator::WalApplyLocked(const std::string& line, double now) {
+  std::istringstream in(line);
+  std::string op;
+  in >> op;
+  auto rest_of_line = [&in]() {
+    std::string rest;
+    std::getline(in, rest);
+    if (!rest.empty() && rest[0] == ' ') rest.erase(0, 1);
+    return rest;
+  };
+  if (op == "P") {
+    std::string k;
+    in >> k;
+    kv_[UnescapeWal(k)] = UnescapeWal(rest_of_line());
+  } else if (op == "D") {
+    std::string k;
+    in >> k;
+    kv_.erase(UnescapeWal(k));
+  } else if (op == "R") {
+    std::string w;
+    int64_t inc = 0;
+    in >> w >> inc;
+    RegisterLocked(w, inc);  // fresh TTL at recovery time
+  } else if (op == "L") {
+    std::string w;
+    in >> w;
+    if (members_.erase(w) > 0) ++epoch_;
+  } else if (op == "X") {
+    std::string w;
+    bool any = false;
+    while (in >> w) any |= members_.erase(w) > 0;
+    if (any) ++epoch_;
+  } else if (op == "B") {
+    std::string name, w;
+    in >> name >> w;
+    barriers_[name][w] = true;
+  } else if (op == "Q") {
+    int64_t n = 0, chunk = 0;
+    int32_t passes = 1, maxfail = 3;
+    double timeout = 16.0;
+    in >> n >> chunk >> passes >> timeout >> maxfail;
+    QueueInitLocked(n, chunk, passes, timeout, maxfail);
+  } else if (op == "G") {
+    int32_t e = 0;
+    in >> e;
+    q_epoch_ = e;
+    FillEpochLocked(q_epoch_);
+  } else if (op == "T") {
+    Task t;
+    std::string w;
+    long long id = 0, start = 0, end = 0;
+    int32_t ep = 0, fails = 0;
+    in >> id >> start >> end >> ep >> fails >> w;
+    t.id = id;
+    t.start = start;
+    t.end = end;
+    t.epoch = ep;
+    t.failures = fails;
+    LeaseAsLocked(t, w, now);
+  } else if (op == "O") {
+    int64_t id = 0;
+    in >> id;
+    RequeueByIdLocked(id);
+  } else if (op == "A") {
+    int64_t id = 0;
+    in >> id;
+    AckLocked(id);
+  } else if (op == "N") {
+    int64_t id = 0;
+    in >> id;
+    NackLocked(id);
+  } else if (op == "W") {
+    std::string w;
+    in >> w;
+    for (auto it = leases_.begin(); it != leases_.end();) {
+      if (it->second.worker == w) {
+        RequeueLocked(it->second.task);
+        it = leases_.erase(it);
+      } else {
+        ++it;
+      }
+    }
+  }
+  // unknown ops are skipped (forward compatibility)
+}
+
 // ---------------------------------------------------------------- KV
 
 void Coordinator::KvPut(const std::string& key, const std::string& value) {
   std::lock_guard<std::mutex> lock(mu_);
   kv_[key] = value;
+  WalAppendLocked("P " + EscapeWal(key, true) + " " + EscapeWal(value, false));
 }
 
 bool Coordinator::KvGet(const std::string& key, std::string* value) const {
@@ -29,22 +221,34 @@ bool Coordinator::KvGet(const std::string& key, std::string* value) const {
 void Coordinator::KvDel(const std::string& key) {
   std::lock_guard<std::mutex> lock(mu_);
   kv_.erase(key);
+  WalAppendLocked("D " + EscapeWal(key, true));
 }
 
 // -------------------------------------------------------- membership
 
-int64_t Coordinator::Register(const std::string& worker, int64_t incarnation) {
-  std::lock_guard<std::mutex> lock(mu_);
+int64_t Coordinator::RegisterLocked(const std::string& worker, int64_t inc) {
   auto it = members_.find(worker);
   // A re-registration with a stale incarnation is a zombie: ignore it
   // (the coordinator owns incarnation ordering — SURVEY §7 hard part (a)).
-  if (it != members_.end() && it->second.incarnation > incarnation) {
+  if (it != members_.end() && it->second.incarnation > inc) {
     return epoch_;
   }
-  bool is_new = it == members_.end() || it->second.incarnation != incarnation;
-  members_[worker] = Member{incarnation, Now() + member_ttl_s_};
+  bool is_new = it == members_.end() || it->second.incarnation != inc;
+  members_[worker] = Member{inc, Now() + member_ttl_s_};
   if (is_new) ++epoch_;
   return epoch_;
+}
+
+int64_t Coordinator::Register(const std::string& worker, int64_t incarnation) {
+  std::lock_guard<std::mutex> lock(mu_);
+  int64_t before = epoch_;
+  bool absent = members_.find(worker) == members_.end();
+  int64_t e = RegisterLocked(worker, incarnation);
+  // log only membership-changing registrations (not pure TTL refresh)
+  if (e != before || absent) {
+    WalAppendLocked("R " + worker + " " + std::to_string(incarnation));
+  }
+  return e;
 }
 
 bool Coordinator::Heartbeat(const std::string& worker) {
@@ -52,28 +256,34 @@ bool Coordinator::Heartbeat(const std::string& worker) {
   auto it = members_.find(worker);
   if (it == members_.end()) return false;
   it->second.expires = Now() + member_ttl_s_;
-  return true;
+  return true;  // TTLs are not persisted: no WAL entry
 }
 
 int64_t Coordinator::Leave(const std::string& worker) {
   std::lock_guard<std::mutex> lock(mu_);
-  if (members_.erase(worker) > 0) ++epoch_;
+  if (members_.erase(worker) > 0) {
+    ++epoch_;
+    WalAppendLocked("L " + worker);
+  }
   return epoch_;
 }
 
 int64_t Coordinator::ExpireMembers() {
   std::lock_guard<std::mutex> lock(mu_);
   double now = Now();
-  bool changed = false;
+  std::string expired;
   for (auto it = members_.begin(); it != members_.end();) {
     if (it->second.expires <= now) {
+      expired += (expired.empty() ? "" : " ") + it->first;
       it = members_.erase(it);
-      changed = true;
     } else {
       ++it;
     }
   }
-  if (changed) ++epoch_;
+  if (!expired.empty()) {
+    ++epoch_;  // one bump per sweep, mirrored by one X line
+    WalAppendLocked("X " + expired);
+  }
   return epoch_;
 }
 
@@ -100,6 +310,9 @@ int32_t Coordinator::BarrierArrive(const std::string& name,
                                    const std::string& worker) {
   std::lock_guard<std::mutex> lock(mu_);
   auto& parties = barriers_[name];
+  if (parties.find(worker) == parties.end()) {
+    WalAppendLocked("B " + name + " " + worker);
+  }
   parties[worker] = true;
   return static_cast<int32_t>(parties.size());
 }
@@ -112,9 +325,9 @@ int32_t Coordinator::BarrierCount(const std::string& name) const {
 
 // -------------------------------------------------------- task queue
 
-void Coordinator::QueueInit(int64_t n_samples, int64_t chunk, int32_t passes,
-                            double lease_timeout_s, int32_t max_failures) {
-  std::lock_guard<std::mutex> lock(mu_);
+void Coordinator::QueueInitLocked(int64_t n_samples, int64_t chunk,
+                                  int32_t passes, double lease_timeout_s,
+                                  int32_t max_failures) {
   todo_.clear();
   leases_.clear();
   dead_.clear();
@@ -128,6 +341,16 @@ void Coordinator::QueueInit(int64_t n_samples, int64_t chunk, int32_t passes,
   max_failures_ = max_failures;
   queue_ready_ = n_samples > 0 && chunk > 0;
   if (queue_ready_) FillEpochLocked(0);
+}
+
+void Coordinator::QueueInit(int64_t n_samples, int64_t chunk, int32_t passes,
+                            double lease_timeout_s, int32_t max_failures) {
+  std::lock_guard<std::mutex> lock(mu_);
+  QueueInitLocked(n_samples, chunk, passes, lease_timeout_s, max_failures);
+  std::ostringstream os;
+  os << "Q " << n_samples << " " << chunk << " " << passes << " "
+     << lease_timeout_s << " " << max_failures;
+  WalAppendLocked(os.str());
 }
 
 void Coordinator::FillEpochLocked(int32_t epoch) {
@@ -150,9 +373,17 @@ void Coordinator::RequeueLocked(Task t) {
   }
 }
 
+void Coordinator::RequeueByIdLocked(int64_t task_id) {
+  auto it = leases_.find(task_id);
+  if (it == leases_.end()) return;
+  RequeueLocked(it->second.task);
+  leases_.erase(it);
+}
+
 void Coordinator::ReapLeasesLocked(double now) {
   for (auto it = leases_.begin(); it != leases_.end();) {
     if (it->second.expires <= now) {
+      WalAppendLocked("O " + std::to_string(it->first));
       RequeueLocked(it->second.task);
       it = leases_.erase(it);
     } else {
@@ -165,9 +396,24 @@ bool Coordinator::AdvanceEpochLocked() {
   if (q_epoch_ < passes_ - 1) {
     ++q_epoch_;
     FillEpochLocked(q_epoch_);
+    WalAppendLocked("G " + std::to_string(q_epoch_));
     return true;
   }
   return false;
+}
+
+void Coordinator::LeaseAsLocked(const Task& t, const std::string& worker,
+                                double now) {
+  // remove by id from todo_ (replay path: the deque order at recovery
+  // can differ from the live order only by requeues, so search)
+  for (auto it = todo_.begin(); it != todo_.end(); ++it) {
+    if (it->id == t.id) {
+      todo_.erase(it);
+      break;
+    }
+  }
+  leases_[t.id] = LeaseRec{t, worker, now + lease_timeout_s_};
+  if (t.id >= next_task_id_) next_task_id_ = t.id + 1;
 }
 
 bool Coordinator::Lease(const std::string& worker, Task* out) {
@@ -179,26 +425,42 @@ bool Coordinator::Lease(const std::string& worker, Task* out) {
   Task t = todo_.front();
   todo_.pop_front();
   leases_[t.id] = LeaseRec{t, worker, Now() + lease_timeout_s_};
+  std::ostringstream os;
+  os << "T " << t.id << " " << t.start << " " << t.end << " " << t.epoch
+     << " " << t.failures << " " << worker;
+  WalAppendLocked(os.str());
   *out = t;
+  return true;
+}
+
+bool Coordinator::AckLocked(int64_t task_id) {
+  auto it = leases_.find(task_id);
+  if (it == leases_.end()) return false;
+  leases_.erase(it);
+  ++done_count_;
   return true;
 }
 
 bool Coordinator::Ack(int64_t task_id) {
   std::lock_guard<std::mutex> lock(mu_);
+  if (!AckLocked(task_id)) return false;
+  WalAppendLocked("A " + std::to_string(task_id));
+  if (todo_.empty() && leases_.empty()) AdvanceEpochLocked();
+  return true;
+}
+
+bool Coordinator::NackLocked(int64_t task_id) {
   auto it = leases_.find(task_id);
   if (it == leases_.end()) return false;
+  RequeueLocked(it->second.task);
   leases_.erase(it);
-  ++done_count_;
-  if (todo_.empty() && leases_.empty()) AdvanceEpochLocked();
   return true;
 }
 
 bool Coordinator::Nack(int64_t task_id) {
   std::lock_guard<std::mutex> lock(mu_);
-  auto it = leases_.find(task_id);
-  if (it == leases_.end()) return false;
-  RequeueLocked(it->second.task);
-  leases_.erase(it);
+  if (!NackLocked(task_id)) return false;
+  WalAppendLocked("N " + std::to_string(task_id));
   return true;
 }
 
@@ -214,6 +476,7 @@ int32_t Coordinator::ReleaseWorker(const std::string& worker) {
       ++it;
     }
   }
+  if (n > 0) WalAppendLocked("W " + worker);
   return n;
 }
 

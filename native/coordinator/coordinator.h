@@ -14,9 +14,21 @@
 //
 // Thread-safe; embedded via the C API (capi.cc -> ctypes) or served over
 // TCP (server_main.cc) for multi-host jobs.
+//
+// Durability: with a WAL path, every state mutation appends one line to
+// a write-ahead log before the call returns (KV writes, membership
+// changes, barrier arrivals, queue init/lease/ack/nack/requeue/epoch
+// fills). A restarted coordinator replays the log and resumes with the
+// exact KV, epoch counter, incarnations, barrier sets, and task-queue
+// accounting it had — the etcd-durability analog the reference gets
+// from its etcd sidecar (pkg/jobparser.go:167-184). Member TTLs and
+// lease expiries restart fresh at recovery time (a dead worker is
+// re-reaped one TTL later; an orphaned lease redelivers one timeout
+// later — safe, just delayed).
 #pragma once
 
 #include <cstdint>
+#include <cstdio>
 #include <deque>
 #include <map>
 #include <mutex>
@@ -41,7 +53,9 @@ struct MemberInfo {
 
 class Coordinator {
  public:
-  explicit Coordinator(double member_ttl_s = 10.0) : member_ttl_s_(member_ttl_s) {}
+  explicit Coordinator(double member_ttl_s = 10.0,
+                       const std::string& wal_path = "");
+  ~Coordinator();
 
   // -- KV (etcd analog) ------------------------------------------------
   void KvPut(const std::string& key, const std::string& value);
@@ -85,11 +99,29 @@ class Coordinator {
   void FillEpochLocked(int32_t epoch);
   void RequeueLocked(Task t);
   void ReapLeasesLocked(double now);
-  bool AdvanceEpochLocked();
+  bool AdvanceEpochLocked();  // logs G on success
   static double Now();
+
+  // -- WAL -------------------------------------------------------------
+  // One line per mutation (see coordinator.cc kWal* ops). Append under
+  // mu_; replay applies the same locked transitions with logging off.
+  void WalAppendLocked(const std::string& line);
+  void WalReplayLocked(const std::string& path);
+  void WalApplyLocked(const std::string& line, double now);
+
+  // shared locked mutators (public API + WAL replay)
+  int64_t RegisterLocked(const std::string& worker, int64_t inc);
+  void QueueInitLocked(int64_t n_samples, int64_t chunk, int32_t passes,
+                       double lease_timeout_s, int32_t max_failures);
+  bool AckLocked(int64_t task_id);
+  bool NackLocked(int64_t task_id);
+  void RequeueByIdLocked(int64_t task_id);  // lease-timeout path (O op)
+  void LeaseAsLocked(const Task& t, const std::string& worker, double now);
 
   mutable std::mutex mu_;
   double member_ttl_s_;
+  std::FILE* wal_ = nullptr;
+  bool replaying_ = false;
 
   std::map<std::string, std::string> kv_;
 

@@ -170,12 +170,26 @@ void Serve(int fd) {
 int main(int argc, char** argv) {
   int port = 7164;  // the reference's default job port (pkg/jobparser.go:50)
   double ttl = 10.0;
+  const char* wal = "";
   for (int i = 1; i < argc - 1; ++i) {
     if (!strcmp(argv[i], "--port")) port = atoi(argv[i + 1]);
     if (!strcmp(argv[i], "--member-ttl")) ttl = atof(argv[i + 1]);
+    // durability: replay + append the write-ahead log (etcd analog) —
+    // a restarted coordinator resumes with exact KV/queue accounting
+    if (!strcmp(argv[i], "--wal")) wal = argv[i + 1];
   }
   signal(SIGPIPE, SIG_IGN);
-  g_coord = new edl::Coordinator(ttl);
+  if (wal[0]) {
+    // preflight: refuse to start "durable" without a writable WAL
+    FILE* f = fopen(wal, "a");
+    if (!f) {
+      printf("edl-coordinator: cannot open WAL %s\n", wal);
+      fflush(stdout);
+      return 1;
+    }
+    fclose(f);
+  }
+  g_coord = new edl::Coordinator(ttl, wal);
 
   int srv = socket(AF_INET, SOCK_STREAM, 0);
   int one = 1;

@@ -98,6 +98,74 @@ def test_queue_release_worker(backend):
 
 
 @pytest.mark.skipif(not HAVE_NATIVE, reason="no C++ toolchain")
+def test_wal_recovery_exact_state(tmp_path):
+    """Durability (VERDICT r2 #2): a coordinator rebuilt from its WAL
+    resumes with exact KV, epoch, incarnations, barriers, and queue
+    accounting — the etcd-durability analog (reference:
+    pkg/jobparser.go:167-184)."""
+    wal = str(tmp_path / "c.wal")
+    c = coord_mod.NativeCoordinator(5.0, wal_path=wal)
+    c.kv_put("dist", "10.0.0.1:7164")
+    c.kv_put("gone", "x")
+    c.kv_del("gone")
+    c.register("w0", 1)
+    c.register("w1", 2)
+    c.barrier_arrive("start", "w0")
+    c.queue_init(100, 10, passes=2, lease_timeout_s=16.0)
+    t1, t2 = c.lease("w0"), c.lease("w1")
+    c.ack(t1.task_id)
+    c.nack(t2.task_id)
+    before = (c.epoch(), c.queue_stats(),
+              [(m.name, m.incarnation, m.rank) for m in c.members()])
+    c.close()
+
+    r = coord_mod.NativeCoordinator(5.0, wal_path=wal)
+    assert r.kv_get("dist") == "10.0.0.1:7164"
+    assert r.kv_get("gone") is None
+    assert (r.epoch(), r.queue_stats(),
+            [(m.name, m.incarnation, m.rank) for m in r.members()]) == before
+    assert r.barrier_count("start") == 1
+    # drain both passes through the recovered instance: exact accounting
+    while True:
+        t = r.lease("w0")
+        if t is None:
+            break
+        r.ack(t.task_id)
+    assert r.queue_done()
+    assert r.queue_stats()["done"] == 20  # 10 chunks x 2 passes, no loss
+    r.close()
+
+
+@pytest.mark.skipif(not HAVE_NATIVE, reason="no C++ toolchain")
+def test_server_sigkill_restart_resumes_and_clients_reconnect(tmp_path):
+    """The TCP coordinator is SIGKILLed mid-queue and restarted on the
+    same port: it recovers from the WAL and existing clients reconnect
+    transparently (backoff re-dial inside CoordinatorClient)."""
+    wal = str(tmp_path / "srv.wal")
+    with CoordinatorServer(member_ttl_s=5.0, wal_path=wal) as srv:
+        c = srv.client()
+        c.kv_put("k", "v1")
+        c.register("w0", 1)
+        c.queue_init(40, 10, 1, 16.0)
+        t = c.lease("w0")
+        assert c.ack(t.task_id)
+        srv.kill()  # SIGKILL, no graceful shutdown
+        srv.restart()
+        # same client object: reconnects and sees recovered state
+        assert c.kv_get("k") == "v1"
+        assert c.queue_stats()["done"] == 1
+        done = 1
+        while True:
+            t = c.lease("w0")
+            if t is None:
+                break
+            assert c.ack(t.task_id)
+            done += 1
+        assert done == 4 and c.queue_done()
+        c.close()
+
+
+@pytest.mark.skipif(not HAVE_NATIVE, reason="no C++ toolchain")
 def test_tcp_server_end_to_end():
     with CoordinatorServer(member_ttl_s=5.0) as srv:
         c1 = srv.client()

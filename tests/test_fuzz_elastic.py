@@ -110,6 +110,15 @@ def test_randomized_kill_scale_schedule(tmp_path, seed):
                 drained.update(launcher.scale_to(a))
                 time.sleep(rng.random() * 0.5)
                 drained.update(launcher.scale_to(b))
+            elif roll < 0.8:
+                # coordinator death: SIGKILL the coordination plane
+                # itself mid-protocol and restart it — the WAL must
+                # restore exact membership/queue state and worker
+                # clients must ride out the outage on reconnect backoff
+                events.append(("coord-restart",))
+                launcher.kill_coordinator()
+                time.sleep(rng.random() * 0.5)
+                launcher.restart_coordinator()
             else:
                 n = rng.randint(1, 4)
                 events.append(("scale", n))
