@@ -341,6 +341,35 @@ def run_monitor(args) -> int:
     return 0
 
 
+def run_export_status(args) -> int:
+    """Inspect (and optionally fetch) the latest servable export — the
+    consumer side of the save_inference_model contract (reference:
+    example/ctr/ctr/train.py:169-180)."""
+    import math
+    import os
+
+    from edl_tpu.runtime.export import export_status
+
+    doc = export_status(args.export_dir)
+    if doc is None:
+        print(f"no published export under {args.export_dir}", file=sys.stderr)
+        return 1
+    n_params = sum(math.prod(s) if s else 1 for s in doc["shapes"].values())
+    print(
+        f"step={doc['step']} dtype={doc['dtype']} "
+        f"leaves={len(doc['shapes'])} params={n_params} "
+        f"dir={doc['_dir']} source={doc['source']}"
+    )
+    if args.fetch:
+        import shutil
+
+        os.makedirs(args.fetch, exist_ok=True)
+        for f in ("params.npz", "manifest.json"):
+            shutil.copy2(os.path.join(doc["_dir"], f), args.fetch)
+        print(f"fetched -> {args.fetch}")
+    return 0
+
+
 def run_validate(args) -> int:
     try:
         job = TrainingJob.from_yaml_file(args.manifest)
@@ -470,6 +499,16 @@ def build_parser() -> argparse.ArgumentParser:
     v = sub.add_parser("validate", help="parse + validate a manifest")
     v.add_argument("manifest")
     v.set_defaults(fn=run_validate)
+
+    ex = sub.add_parser(
+        "export-status",
+        help="show (and optionally fetch) the latest servable export",
+    )
+    ex.add_argument("export_dir")
+    ex.add_argument(
+        "--fetch", default=None, help="copy the latest export to this dir"
+    )
+    ex.set_defaults(fn=run_export_status)
 
     return p
 

@@ -1,0 +1,231 @@
+"""Inference export — params-only servable artifacts.
+
+The reference emits a servable model alongside training on a cadence and
+at every pass end (``save_inference_model``, reference:
+example/ctr/ctr/train.py:169-180, example/fit_a_line/fluid/
+recognize_digits.py:84-88). The TPU translation: a **params-only,
+dtype-cast** export directory with an atomically-updated ``latest``
+pointer, written by the commit leader (worker runtime) or any trainer
+process — decoupled from the full TrainState checkpoints, which carry
+optimizer state and exist for resume/reshard, not serving.
+
+Layout::
+
+    <root>/step-00000042/params.npz     leaf path -> array
+    <root>/step-00000042/manifest.json  step, dtype, shapes, source
+    <root>/latest                       "step-00000042"  (renamed last)
+
+bfloat16 leaves are stored as uint16 views (npz has no native bf16) and
+restored through ml_dtypes on load. A consumer needs only
+:func:`load_export` + the model's ``forward`` — no optimizer, no mesh.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+_FLOATS = ("float64", "float32", "float16", "bfloat16")
+
+
+def _bf16():
+    import ml_dtypes
+
+    return np.dtype(ml_dtypes.bfloat16)
+
+
+def _leaf_keys(tree):
+    import jax
+
+    out = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        key = "/".join(jax.tree_util.keystr((p,)).strip("[]'.") for p in path)
+        out.append((key, leaf))
+    return out
+
+
+def _cast(arr: np.ndarray, dtype: str) -> np.ndarray:
+    """Cast float arrays to the export dtype; ints/bools pass through."""
+    if arr.dtype.name not in _FLOATS or dtype == "none":
+        return arr
+    if dtype == "bfloat16":
+        return arr.astype(_bf16())
+    return arr.astype(np.dtype(dtype))
+
+
+def _store_view(arr: np.ndarray) -> Tuple[np.ndarray, str]:
+    """(npz-safe array, recorded dtype name)."""
+    if arr.dtype == _bf16():
+        return arr.view(np.uint16), "bfloat16"
+    return arr, arr.dtype.name
+
+
+def _write_export(
+    root: str,
+    step: int,
+    flat: Dict[str, np.ndarray],
+    dtype: str,
+    source: str,
+) -> str:
+    d = os.path.join(root, f"step-{step:08d}")
+    os.makedirs(d, exist_ok=True)
+    payload, dtypes, shapes = {}, {}, {}
+    for key, arr in flat.items():
+        arr = _cast(np.asarray(arr), dtype)
+        stored, name = _store_view(arr)
+        payload[key] = stored
+        dtypes[key] = name
+        shapes[key] = list(arr.shape)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz.tmp")
+    os.close(fd)
+    with open(tmp, "wb") as f:
+        np.savez(f, **payload)
+    os.replace(tmp, os.path.join(d, "params.npz"))
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".json.tmp")
+    os.close(fd)
+    with open(tmp, "w") as f:
+        json.dump(
+            {
+                "step": step,
+                "dtype": dtype,
+                "dtypes": dtypes,
+                "shapes": shapes,
+                "source": source,
+            },
+            f,
+        )
+    os.replace(tmp, os.path.join(d, "manifest.json"))
+    # the latest pointer is the publish: renamed into place LAST, so a
+    # consumer never sees a half-written export. Monotonic max-write —
+    # a slow writer (stalled background commit) must not regress the
+    # pointer past a newer publish (same rule as worker_main's
+    # ckpt_step); its dir stays unpointed and is reaped by the GC.
+    cur = export_status(root)
+    if cur is None or int(cur["step"]) < step:
+        fd, tmp = tempfile.mkstemp(dir=root)
+        with os.fdopen(fd, "w") as f:
+            f.write(os.path.basename(d))
+        os.replace(tmp, os.path.join(root, "latest"))
+    _gc_exports(root, keep=2)
+    return d
+
+
+def _gc_exports(root: str, keep: int = 2) -> None:
+    """Reap superseded export dirs (newest ``keep`` pointed-or-newer
+    survive) — without this every commit leaks a full model copy."""
+    import shutil
+
+    doc = export_status(root)
+    if doc is None:
+        return
+    pointed = os.path.basename(doc["_dir"])
+    dirs = sorted(d for d in os.listdir(root) if d.startswith("step-"))
+    # keep the pointed dir, the newest keep-1 others at or below it,
+    # and anything newer (an in-progress publish about to take over)
+    older = [d for d in dirs if d <= pointed and d != pointed]
+    victims = older[: max(0, len(older) - (keep - 1))]
+    for d in victims:
+        shutil.rmtree(os.path.join(root, d), ignore_errors=True)
+
+
+def export_params(
+    root: str,
+    params: Any,
+    step: int,
+    dtype: str = "bfloat16",
+    source: str = "in-process",
+) -> str:
+    """Export an in-process (possibly device-resident) param tree.
+    Returns the export step directory."""
+    import jax
+
+    flat = {}
+    for key, leaf in _leaf_keys(params):
+        flat[key] = np.asarray(jax.device_get(leaf))
+    return _write_export(root, step, flat, dtype, source)
+
+
+def export_from_checkpoint(
+    ckpt_root: str, export_root: str, dtype: str = "bfloat16", ram=None
+) -> Optional[str]:
+    """Assemble the params (only) of the newest committed sharded
+    checkpoint into a servable export — the commit-leader path for
+    param-sharded (fsdp) jobs where no single process holds the model.
+    Host-side file work; no devices, no collectives. ``ram`` (a
+    LocalSnapshot at the same step) serves this rank's pieces from
+    memory instead of re-reading its own just-written shards. Returns
+    the export dir, or None without a committed checkpoint."""
+    from edl_tpu.runtime import checkpoint as ckpt
+
+    manifest = ckpt.latest_manifest(ckpt_root)
+    if manifest is None:
+        return None
+    step = int(manifest["step"])
+    cur = export_status(export_root)
+    if cur is not None and int(cur["step"]) >= step:
+        return None  # already exported this (or a newer) step
+    if ram is not None and ram.step != step:
+        ram = None  # stale snapshot: trust only manifest-listed files
+    index = ckpt._PieceIndex(manifest, ram)
+    try:
+        flat = {}
+        for fq, shape in manifest["shapes"].items():
+            if not fq.startswith("p:"):
+                continue  # params only: optimizer state never ships
+            shape = tuple(shape)
+            arr = index.assemble(
+                fq,
+                tuple(slice(None) for _ in shape),
+                shape,
+                np.dtype(manifest["dtypes"][fq]),
+            )
+            flat[fq[2:]] = arr
+    finally:
+        index.close()
+    return _write_export(
+        export_root, step, flat, dtype, source=f"checkpoint:{ckpt_root}"
+    )
+
+
+def export_status(root: str) -> Optional[Dict[str, Any]]:
+    """Manifest of the latest published export (with ``_dir``), or
+    None. The ``latest`` pointer is authoritative — unpointed step dirs
+    are in-progress or abandoned."""
+    ptr = os.path.join(root, "latest")
+    if not os.path.exists(ptr):
+        return None
+    with open(ptr) as f:
+        name = f.read().strip()
+    mpath = os.path.join(root, name, "manifest.json")
+    if not os.path.exists(mpath):
+        return None
+    with open(mpath) as f:
+        doc = json.load(f)
+    doc["_dir"] = os.path.join(root, name)
+    return doc
+
+
+def load_export(root: str) -> Tuple[Any, Dict[str, Any]]:
+    """(params tree, manifest) of the latest export. The tree is a
+    nested dict rebuilt from the flat leaf paths — exactly the structure
+    every model's ``forward`` consumes; a serving process needs no
+    TrainState, optimizer, or mesh."""
+    doc = export_status(root)
+    if doc is None:
+        raise FileNotFoundError(f"no published export under {root}")
+    params: Dict[str, Any] = {}
+    with np.load(os.path.join(doc["_dir"], "params.npz")) as z:
+        for key in z.files:
+            arr = z[key]
+            if doc["dtypes"].get(key) == "bfloat16":
+                arr = arr.view(_bf16())
+            node = params
+            parts = key.split("/")
+            for p in parts[:-1]:
+                node = node.setdefault(p, {})
+            node[parts[-1]] = arr
+    return params, doc

@@ -59,6 +59,7 @@ class ProcessJobLauncher:
     seed: int = 0
     seq_len: int = 32  # llama workload sequence length
     data_dir: str = ""  # on-disk dataset (runtime/shards.py layout)
+    export: bool = False  # publish servable params exports (export_dir)
     step_sleep_s: float = 0.0
     sync_every: int = 1  # delayed-sync DP: K local steps between averages
     extra_env: Dict[str, str] = field(default_factory=dict)
@@ -101,6 +102,10 @@ class ProcessJobLauncher:
     def log_dir(self) -> str:
         return os.path.join(self.work_dir, "logs")
 
+    @property
+    def export_dir(self) -> str:
+        return os.path.join(self.work_dir, "export")
+
     # -- pod lifecycle -------------------------------------------------------
 
     def _env(self, worker_id: str) -> Dict[str, str]:
@@ -126,6 +131,7 @@ class ProcessJobLauncher:
                 "EDL_LEASE_TIMEOUT_S": str(self.lease_timeout_s),
                 "EDL_MEMBER_TTL_S": str(self.member_ttl_s),
                 "EDL_CKPT_DIR": self.ckpt_dir,
+                "EDL_EXPORT_DIR": self.export_dir if self.export else "",
                 "EDL_LOG_DIR": self.log_dir,
                 "EDL_SEED": str(self.seed),
                 "EDL_STEP_SLEEP_S": str(self.step_sleep_s),

@@ -114,6 +114,11 @@ class WorkerConfig:
     data_dir: str = ""
     rendezvous_timeout_s: float = 120.0
     step_sleep_s: float = 0.0  # throttle (tests: keeps jobs scalable mid-run)
+    # servable export root: the commit leader writes a params-only,
+    # dtype-cast artifact at every checkpoint commit and at stop
+    # (reference save_inference_model, example/ctr/ctr/train.py:169-180)
+    export_dir: str = ""
+    export_dtype: str = "bfloat16"
     # delayed-sync DP: K local steps per dp group between cross-group
     # averages (trainer.LocalSyncStepper; the --async_mode analog,
     # reference example/ctr/ctr/train.py:75-79). 1 = fully synchronous.
@@ -158,6 +163,8 @@ class WorkerConfig:
             rendezvous_timeout_s=float(e.get("EDL_RENDEZVOUS_TIMEOUT_S", "120")),
             step_sleep_s=float(e.get("EDL_STEP_SLEEP_S", "0")),
             sync_every=int(e.get("EDL_SYNC_EVERY", "1")),
+            export_dir=e.get("EDL_EXPORT_DIR", ""),
+            export_dtype=e.get("EDL_EXPORT_DTYPE", "bfloat16"),
         )
 
 
@@ -697,6 +704,29 @@ class ElasticWorker:
                     if snap.step > cur:
                         client.kv_put(self._k("ckpt_step"), str(snap.step))
                     ckpt.gc_step_dirs(cfg.ckpt_dir, keep=2)
+                    if cfg.export_dir:
+                        # servable params-only artifact on every commit
+                        # (the save_inference_model cadence, reference
+                        # example/ctr/ctr/train.py:169-180) — assembled
+                        # from the shards just committed, so it works
+                        # for fsdp states no single process holds
+                        try:
+                            from edl_tpu.runtime import export as exp
+
+                            d = exp.export_from_checkpoint(
+                                cfg.ckpt_dir,
+                                cfg.export_dir,
+                                dtype=cfg.export_dtype,
+                                ram=snap,  # skip re-reading own shards
+                            )
+                            if d:
+                                log.info(
+                                    "export published",
+                                    dir=d,
+                                    step=snap.step,
+                                )
+                        except Exception as e:  # pragma: no cover
+                            log.error("export failed", error=str(e))
                 else:  # pragma: no cover - crash-timing path
                     # surfaced as a counter so monitors can alarm on
                     # repeated aborts (a job silently training without

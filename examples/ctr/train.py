@@ -35,6 +35,10 @@ def main() -> int:
                     help="checkpoint period in steps (0 = off; "
                     "reference: save_inference_model every 1000 batches)")
     ap.add_argument("--ckpt-dir", default="/tmp/edl-ctr-ckpt")
+    ap.add_argument("--export-dir", default="",
+                    help="publish a servable params-only export here on "
+                    "the ckpt cadence and at the end (reference: "
+                    "save_inference_model, ctr/train.py:169-180)")
     ap.add_argument("--data-dir", default="",
                     help="shard-manifest dataset dir; prepared with "
                     "synthetic rows when absent (the reference pre-bakes "
@@ -58,6 +62,7 @@ def main() -> int:
     from edl_tpu.controller.controller import Controller
     from edl_tpu.models import ctr
     from edl_tpu.runtime import checkpoint as ckpt
+    from edl_tpu.runtime.export import export_params
     from edl_tpu.runtime.data import ElasticDataQueue, QueueBatcher
     from edl_tpu.runtime.local import LocalJobRunner
     from edl_tpu.runtime.shards import FileShardSource, write_shards
@@ -104,6 +109,18 @@ def main() -> int:
     runner.trainer.train_steps(data_fn, third)
     ctl.autoscaler.tick()  # grow into the idle fleet -> in-place reshard
     report = None
+    exported = [-1]
+
+    def publish_export(tag=""):
+        step_now = int(runner.trainer.state.step)
+        if not args.export_dir or step_now <= exported[0]:
+            return
+        d = export_params(
+            args.export_dir, runner.trainer.merged_state.params, step_now
+        )
+        exported[0] = step_now
+        print(f"{tag}export published: {d}")
+
     for start in range(third, args.steps, third):
         n = min(third, args.steps - start)
         report = runner.trainer.train_steps(data_fn, n)
@@ -111,6 +128,7 @@ def main() -> int:
             path = os.path.join(args.ckpt_dir, f"step-{int(runner.trainer.state.step)}")
             ckpt.save(path, runner.trainer.state)
             print(f"checkpoint saved: {path}")
+            publish_export()
 
     stats = queue.progress()
     print(
@@ -121,6 +139,7 @@ def main() -> int:
         f"reshards={[(e.from_workers, e.to_workers) for e in report.reshards]}, "
         f"data: {stats['done']} file chunks acked from {data_dir}"
     )
+    publish_export(tag="final ")
     runner.detach()
     return 0
 

@@ -25,6 +25,11 @@ def main() -> int:
     ap.add_argument("--seq", type=int, default=128)
     ap.add_argument("--per-shard-batch", type=int, default=2)
     ap.add_argument(
+        "--export-dir",
+        default="",
+        help="publish a servable bf16 params-only export at the end",
+    )
+    ap.add_argument(
         "--mesh",
         default="",
         help='MeshPlan.parse override, e.g. "sp=2,dp" (ring attention) '
@@ -79,6 +84,11 @@ def main() -> int:
         print(f"step {i}: loss={float(metrics['loss']):.4f}")
 
     assert int(state.step) == args.steps
+    if args.export_dir:
+        from edl_tpu.runtime.export import export_params
+
+        d = export_params(args.export_dir, state.params, int(state.step))
+        print(f"export published: {d}")
     print("ok")
     return 0
 

