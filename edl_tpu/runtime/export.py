@@ -25,11 +25,17 @@ from __future__ import annotations
 import json
 import os
 import tempfile
+import threading
 from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
 _FLOATS = ("float64", "float32", "float16", "bfloat16")
+
+# serializes the read-check-rename publish against concurrent in-process
+# writers (worker_main's background commit threads). Cross-process races
+# are excluded by commit-leader election: exactly one process exports.
+_publish_lock = threading.Lock()
 
 
 def _bf16():
@@ -39,13 +45,12 @@ def _bf16():
 
 
 def _leaf_keys(tree):
-    import jax
+    # the ONE key-derivation rule, shared with the checkpoint format —
+    # in-process exports and checkpoint-assembled exports must produce
+    # identically-keyed trees
+    from edl_tpu.runtime.checkpoint import _leaf_keys as ck
 
-    out = []
-    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
-        key = "/".join(jax.tree_util.keystr((p,)).strip("[]'.") for p in path)
-        out.append((key, leaf))
-    return out
+    return ck(tree)
 
 
 def _cast(arr: np.ndarray, dtype: str) -> np.ndarray:
@@ -103,14 +108,18 @@ def _write_export(
     # consumer never sees a half-written export. Monotonic max-write —
     # a slow writer (stalled background commit) must not regress the
     # pointer past a newer publish (same rule as worker_main's
-    # ckpt_step); its dir stays unpointed and is reaped by the GC.
-    cur = export_status(root)
-    if cur is None or int(cur["step"]) < step:
-        fd, tmp = tempfile.mkstemp(dir=root)
-        with os.fdopen(fd, "w") as f:
-            f.write(os.path.basename(d))
-        os.replace(tmp, os.path.join(root, "latest"))
-    _gc_exports(root, keep=2)
+    # ckpt_step); its dir stays unpointed and is reaped by the GC. The
+    # lock makes the read-check-rename atomic among this process's
+    # threads (the only concurrent writers: leader election is
+    # per-process).
+    with _publish_lock:
+        cur = export_status(root)
+        if cur is None or int(cur["step"]) < step:
+            fd, tmp = tempfile.mkstemp(dir=root)
+            with os.fdopen(fd, "w") as f:
+                f.write(os.path.basename(d))
+            os.replace(tmp, os.path.join(root, "latest"))
+        _gc_exports(root, keep=2)
     return d
 
 

@@ -17,9 +17,10 @@ from __future__ import annotations
 import json
 import re
 import threading
+import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 # (group, version, namespaced plural) -> kind
 ROUTES = {
@@ -48,10 +49,35 @@ class _State:
             key: {} for key in ROUTES
         }
         self.rv = 0
+        # watch journal: every mutation appends an event with its own
+        # monotone sequence number (the watch analog of etcd revisions)
+        self.events: List[dict] = []
+        # non-watch LIST hits per route — lets tests prove a streaming
+        # watcher is NOT relisting every tick
+        self.list_counts: Dict[Tuple[str, str], int] = {}
 
     def next_rv(self) -> str:
         self.rv += 1
         return str(self.rv)
+
+    def record(self, key, ns: str, name: str, etype: str, obj: dict) -> None:
+        """Append a watch event (caller holds the lock). The event's
+        object carries the event's own resourceVersion — as in k8s,
+        where the mutation's new rv IS what the watch delivers and what
+        clients resume from."""
+        rv = int(self.next_rv())
+        copy = json.loads(json.dumps(obj))
+        copy.setdefault("metadata", {})["resourceVersion"] = str(rv)
+        self.events.append(
+            {
+                "rv": rv,
+                "key": key,
+                "ns": ns,
+                "name": name,
+                "type": etype,
+                "object": copy,
+            }
+        )
 
 
 def _match_label_selector(obj: dict, selector: str) -> bool:
@@ -147,6 +173,8 @@ class FakeKubeServer:
                 if r is None:
                     return self._error(404, f"no route {self.path}")
                 key, ns, name, _, params = r
+                if not name and params.get("watch") == "true":
+                    return self._watch(key, ns, params)
                 with state.lock:
                     store = state.objects[key]
                     if name:
@@ -154,6 +182,7 @@ class FakeKubeServer:
                         if obj is None:
                             return self._error(404, f"{name} not found")
                         return self._send(200, obj)
+                    state.list_counts[key] = state.list_counts.get(key, 0) + 1
                     items = [
                         o for (ons, _), o in sorted(store.items())
                         if ns is None or ons == ns
@@ -166,8 +195,45 @@ class FakeKubeServer:
                                  if _match_field_selector(o, params["fieldSelector"])]
                     return self._send(200, {
                         "kind": ROUTES[key] + "List",
+                        "metadata": {"resourceVersion": str(state.rv)},
                         "items": items,
                     })
+
+            def _watch(self, key, ns, params):
+                """Streaming watch: line-delimited JSON events with
+                rv > resourceVersion, held open for timeoutSeconds
+                (the real API-server contract the client resumes on)."""
+                since = int(params.get("resourceVersion") or 0)
+                timeout = float(params.get("timeoutSeconds") or 30)
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Connection", "close")
+                self.end_headers()
+                deadline = time.monotonic() + timeout
+                sent = since
+                try:
+                    while time.monotonic() < deadline:
+                        with state.lock:
+                            pending = [
+                                e for e in state.events
+                                if e["rv"] > sent
+                                and e["key"] == key
+                                and (ns is None or e["ns"] == ns)
+                            ]
+                        for e in pending:
+                            line = json.dumps(
+                                {"type": e["type"], "object": e["object"]}
+                            )
+                            self.wfile.write(line.encode() + b"\n")
+                            sent = max(sent, e["rv"])
+                        # heartbeat (clients skip blank lines): makes a
+                        # dead client raise BrokenPipe so the handler
+                        # exits instead of idling out the whole window
+                        self.wfile.write(b"\n")
+                        self.wfile.flush()
+                        time.sleep(0.02)
+                except (BrokenPipeError, ConnectionResetError):
+                    pass  # client went away
 
             def do_POST(self):
                 r = self._route()
@@ -187,6 +253,7 @@ class FakeKubeServer:
                     meta["resourceVersion"] = state.next_rv()
                     obj.setdefault("status", {})
                     store[(ons, oname)] = obj
+                    state.record(key, ons, oname, "ADDED", obj)
                     return self._send(201, obj)
 
             def do_PUT(self):
@@ -212,6 +279,7 @@ class FakeKubeServer:
                         body["metadata"].setdefault("namespace", ns or "default")
                         store[(ns or "", name)] = body
                         cur = body
+                    state.record(key, ns or "", name, "MODIFIED", cur)
                     return self._send(200, cur)
 
             def do_PATCH(self):
@@ -248,6 +316,7 @@ class FakeKubeServer:
                     else:
                         merge(cur, patch)
                         cur["metadata"]["resourceVersion"] = state.next_rv()
+                    state.record(key, ns or "", name, "MODIFIED", cur)
                     return self._send(200, cur)
 
             def do_DELETE(self):
@@ -262,6 +331,7 @@ class FakeKubeServer:
                     obj = store.pop((ns or "", name), None)
                     if obj is None:
                         return self._error(404, f"{name} not found")
+                    state.record(key, ns or "", name, "DELETED", obj)
                     # cascade: Job deletion removes its pods (the k8s GC
                     # analog; KubeCluster passes propagationPolicy)
                     if key == ("batch/v1", "jobs"):
@@ -381,20 +451,32 @@ class FakeKubeServer:
             ] = phase
 
     def create_training_job(self, manifest: dict) -> None:
+        key = ("edl-tpu.org/v1", "trainingjobs")
         with self.state.lock:
             meta = manifest.setdefault("metadata", {})
             ns = meta.setdefault("namespace", "default")
             meta["resourceVersion"] = self.state.next_rv()
             manifest.setdefault("status", {})
-            self.state.objects[("edl-tpu.org/v1", "trainingjobs")][
-                (ns, meta["name"])
-            ] = manifest
+            existed = (ns, meta["name"]) in self.state.objects[key]
+            self.state.objects[key][(ns, meta["name"])] = manifest
+            self.state.record(
+                key, ns, meta["name"],
+                "MODIFIED" if existed else "ADDED", manifest,
+            )
 
     def delete_training_job(self, namespace: str, name: str) -> None:
+        key = ("edl-tpu.org/v1", "trainingjobs")
         with self.state.lock:
-            self.state.objects[("edl-tpu.org/v1", "trainingjobs")].pop(
-                (namespace, name), None
-            )
+            obj = self.state.objects[key].pop((namespace, name), None)
+            if obj is not None:
+                self.state.record(key, namespace, name, "DELETED", obj)
+
+    def list_count(self, gv: str = "edl-tpu.org/v1",
+                   plural: str = "trainingjobs") -> int:
+        """Non-watch LIST hits for a route — proves a streaming watcher
+        is not relisting per tick."""
+        with self.state.lock:
+            return self.state.list_counts.get((gv, plural), 0)
 
     def get_object(self, gv: str, plural: str, namespace: str, name: str):
         with self.state.lock:

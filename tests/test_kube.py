@@ -9,6 +9,8 @@ integration harness SURVEY §4 says the reference's fake clientset was
 meant for but never got.
 """
 
+import time
+
 import pytest
 
 from edl_tpu.api import job as job_api
@@ -264,7 +266,8 @@ def test_training_job_source_and_status(server, cluster):
 
 
 def test_job_source_diffs_events(server, cluster):
-    src = KubeJobSource(cluster)
+    # watch=False: this test pins the pure poll-diff fallback semantics
+    src = KubeJobSource(cluster, watch=False)
     events = []
     cb = lambda kind: lambda j: events.append((kind, j.name))  # noqa: E731
 
@@ -381,9 +384,15 @@ def test_control_plane_end_to_end_over_kube(server, cluster):
     assert obj["status"]["phase"] in ("running", "scaling")
     assert obj["status"]["parallelism"] == 8
 
-    # deletion drains children
+    # deletion drains children (the DELETED event rides the watch
+    # stream, so tick until it lands)
     server.delete_training_job("default", "e2e")
-    source.poll(controller.on_add, controller.on_update, controller.on_delete)
+    deadline = time.monotonic() + 10
+    while server.get_object("batch/v1", "jobs", "default", "e2e-worker"):
+        source.poll(controller.on_add, controller.on_update, controller.on_delete)
+        if time.monotonic() > deadline:
+            break
+        time.sleep(0.05)
     assert server.get_object("batch/v1", "jobs", "default", "e2e-worker") is None
     assert (
         server.get_object("apps/v1", "deployments", "default", "e2e-coordinator")
@@ -394,7 +403,7 @@ def test_control_plane_end_to_end_over_kube(server, cluster):
 def test_job_source_keeps_unparseable_job(server, cluster):
     """A CR that stops parsing (bad kubectl edit, schema drift) must not
     be diffed as a deletion — that would tear down the live job."""
-    src = KubeJobSource(cluster)
+    src = KubeJobSource(cluster, watch=False)
     events = []
     cb = lambda kind: lambda j: events.append((kind, j.name))  # noqa: E731
 
@@ -458,7 +467,7 @@ def test_same_name_jobs_in_two_namespaces_do_not_collide(server, cluster):
     from edl_tpu.controller.controller import Controller
 
     ctl = Controller(cluster)
-    src = KubeJobSource(cluster)
+    src = KubeJobSource(cluster, watch=False)
     for ns in ("team-a", "team-b"):
         server.create_training_job(
             {
@@ -499,3 +508,91 @@ def test_coordinator_create_repairs_missing_service(server, cluster):
     repaired = cluster.create_coordinator(plan)  # 409 on Deployment is OK
     assert not repaired.endpoint.endswith(":0")
     assert not cluster.get_coordinator("default", plan.name).endpoint.endswith(":0")
+
+
+# -- streaming watch (VERDICT r2 Missing #4) --------------------------------
+
+
+def _poll_until(src, events, want, timeout_s=10.0):
+    """Tick the source until `want(events)` holds (watch events arrive
+    asynchronously, unlike the synchronous poll-diff mode)."""
+    cb = lambda kind: lambda j: events.append((kind, j.name))  # noqa: E731
+    deadline = time.monotonic() + timeout_s
+    while True:
+        src.poll(cb("add"), cb("upd"), cb("del"))
+        if want(events):
+            return
+        if time.monotonic() > deadline:
+            raise TimeoutError(events)
+        time.sleep(0.05)
+
+
+def test_watch_streams_events_without_relisting(server, cluster):
+    """Steady state costs ZERO list calls: adds/updates/deletes arrive
+    over the streaming watch connection (informer semantics, reference
+    pkg/controller.go:79-108)."""
+    src = KubeJobSource(cluster, watch=True)
+    events = []
+    src.poll(lambda j: None, lambda j: None, lambda j: None)  # relist + start
+    lists_after_start = server.list_count()
+
+    server.create_training_job(
+        {"metadata": {"name": "a", "namespace": "default"},
+         "spec": {"worker": {"min_replicas": 1, "max_replicas": 2}}}
+    )
+    _poll_until(src, events, lambda e: ("add", "a") in e)
+
+    obj = server.get_object("edl-tpu.org/v1", "trainingjobs", "default", "a")
+    obj["spec"]["worker"]["max_replicas"] = 6
+    server.create_training_job(obj)  # overwrite -> MODIFIED event
+    _poll_until(src, events, lambda e: ("upd", "a") in e)
+
+    server.delete_training_job("default", "a")
+    _poll_until(src, events, lambda e: ("del", "a") in e)
+
+    # the whole add/update/delete flow rode the stream: no extra LISTs
+    assert server.list_count() == lists_after_start
+    src.close()
+
+
+def test_watch_resumes_after_stream_window_closes(server, cluster):
+    """The server closes each watch window after timeoutSeconds; the
+    client re-watches from its last resourceVersion and misses nothing."""
+    src = KubeJobSource(cluster, watch=True, watch_timeout_s=1.0)
+    events = []
+    src.poll(lambda j: None, lambda j: None, lambda j: None)
+    time.sleep(1.6)  # at least one window expiry + re-watch
+    server.create_training_job(
+        {"metadata": {"name": "late", "namespace": "default"},
+         "spec": {"worker": {"min_replicas": 1, "max_replicas": 2}}}
+    )
+    _poll_until(src, events, lambda e: ("add", "late") in e)
+    src.close()
+
+
+def test_watch_falls_back_to_list_diff_when_stream_dies(server, cluster):
+    """A dead watch thread is not a dead source: the next poll relists
+    (full diff) and restarts the stream."""
+    src = KubeJobSource(cluster, watch=True)
+    events = []
+    src.poll(lambda j: None, lambda j: None, lambda j: None)
+    # kill the stream from the client side (simulates apiserver drop);
+    # close() interrupts the blocked read so this is bounded, not a
+    # wait-out of the watch window
+    src.close()
+    deadline = time.monotonic() + 5
+    while src._watch_healthy():
+        assert time.monotonic() < deadline, "watch thread failed to exit"
+        time.sleep(0.02)
+    src._stop = False
+
+    server.create_training_job(
+        {"metadata": {"name": "b", "namespace": "default"},
+         "spec": {"worker": {"min_replicas": 1, "max_replicas": 2}}}
+    )
+    # first poll after death relists -> synchronous add, watch restarts
+    cb = lambda kind: lambda j: events.append((kind, j.name))  # noqa: E731
+    src.poll(cb("add"), cb("upd"), cb("del"))
+    assert ("add", "b") in events
+    assert src._watch_healthy()
+    src.close()
