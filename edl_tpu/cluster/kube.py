@@ -124,14 +124,16 @@ class KubeApi:
                 token = f.read().strip()
         return cls(f"https://{host}:{port}", token=token, ca_path=SA_CA_PATH)
 
-    def request(
+    def _build_request(
         self,
         method: str,
         path: str,
         body: Optional[dict] = None,
         params: Optional[Dict[str, str]] = None,
         content_type: str = "application/json",
-    ) -> dict:
+    ) -> Tuple[str, urllib.request.Request]:
+        """One place for URL/params encoding, Accept, auth — shared by
+        the unary verbs and the streaming watch so they cannot drift."""
         url = self.base_url + path
         if params:
             url += "?" + urllib.parse.urlencode(params)
@@ -142,6 +144,17 @@ class KubeApi:
             req.add_header("Content-Type", content_type)
         if self.token:
             req.add_header("Authorization", f"Bearer {self.token}")
+        return url, req
+
+    def request(
+        self,
+        method: str,
+        path: str,
+        body: Optional[dict] = None,
+        params: Optional[Dict[str, str]] = None,
+        content_type: str = "application/json",
+    ) -> dict:
+        url, req = self._build_request(method, path, body, params, content_type)
         try:
             with urllib.request.urlopen(
                 req, timeout=self.timeout_s, context=self._ssl
@@ -185,11 +198,7 @@ class KubeApi:
         params = {"watch": "true", "timeoutSeconds": str(max(1, int(timeout_s)))}
         if resource_version:
             params["resourceVersion"] = resource_version
-        url = self.base_url + path + "?" + urllib.parse.urlencode(params)
-        req = urllib.request.Request(url, method="GET")
-        req.add_header("Accept", "application/json")
-        if self.token:
-            req.add_header("Authorization", f"Bearer {self.token}")
+        url, req = self._build_request("GET", path, params=params)
         try:
             with urllib.request.urlopen(
                 req, timeout=timeout_s + 10, context=self._ssl
@@ -754,6 +763,8 @@ class KubeJobSource:
                 # clean EOF: the server closed the watch window —
                 # re-watch from the last seen resourceVersion
             except Exception as e:
+                if self._stop:
+                    return  # close() interrupted the read: clean exit
                 log.warn(
                     "watch stream broke; falling back to list diff",
                     error=str(e),

@@ -153,15 +153,88 @@ class MeshPlan:
 
     # -- construction ------------------------------------------------------
 
-    def build(self, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    # axes that may cross a slice boundary (ride DCN): the batch-ish
+    # outer axes, whose collectives are an all-reduce per step (dp) or a
+    # once-per-microbatch neighbor transfer (pp). Everything inner
+    # (fsdp/sp/ep/tp) does per-layer collectives and must stay on ICI.
+    DCN_AXES: Tuple[str, ...] = ("dp", "pp")
+
+    def build(
+        self,
+        devices: Optional[Sequence[jax.Device]] = None,
+        slices: Optional[Sequence[int]] = None,
+    ) -> Mesh:
         """Materialize a ``jax.sharding.Mesh``. Devices default to all
-        local devices; an elastic reshard passes the surviving subset."""
+        local devices; an elastic reshard passes the surviving subset.
+
+        Multi-slice topology (SURVEY §2.5 comm-backend row, §7(c)):
+        when the devices span >1 TPU slice — detected from each
+        device's ``slice_index``, or declared via ``slices`` (a
+        parallel list of slice ids, the virtual-topology hook for
+        tests/dryruns) — devices are ordered slice-major so the
+        DCN-tolerant outer axes (dp, pp — first in AXIS_ORDER) vary
+        ACROSS slices while fsdp/sp/ep/tp stay inside one slice's ICI.
+        The build fails loudly if an inner-axis block would straddle a
+        slice boundary (a per-layer collective over DCN is a config
+        error, not a degraded mode)."""
         devs = list(devices) if devices is not None else list(jax.devices())
         n = self.size()
         if len(devs) < n:
             raise ValueError(f"mesh needs {n} devices, have {len(devs)}")
-        arr = np.array(devs[:n]).reshape(self.shape)
+        if slices is not None:
+            if len(slices) != len(devs):
+                raise ValueError(
+                    f"slices has {len(slices)} entries for {len(devs)} devices"
+                )
+            slice_of = dict(zip([id(d) for d in devs], slices))
+            get_slice = lambda d: slice_of[id(d)]
+        else:
+            get_slice = lambda d: getattr(d, "slice_index", None)
+        marks = [get_slice(d) for d in devs]
+        multi = len({m for m in marks if m is not None}) > 1
+        if multi:
+            # slice-major order: a stable sort keeps the intra-slice
+            # device order (ICI neighbors stay adjacent)
+            devs = sorted(devs, key=lambda d: (get_slice(d) is None, get_slice(d)))
+        devs = devs[:n]
+        arr = np.array(devs).reshape(self.shape)
+        if multi:
+            self._check_slice_alignment(arr, get_slice)
         return Mesh(arr, self.names)
+
+    def _check_slice_alignment(self, arr: np.ndarray, get_slice) -> None:
+        """Every inner-axis block (all axes after dp/pp) must live in
+        ONE slice; dp/pp coordinates may map to different slices."""
+        outer = math.prod(
+            s for a, s in self.axes if a in self.DCN_AXES
+        ) or 1
+        flat = arr.reshape(outer, -1)
+        for row in range(flat.shape[0]):
+            row_slices = {get_slice(d) for d in flat[row]}
+            if len(row_slices) > 1:
+                raise ValueError(
+                    f"mesh axes {dict(self.axes)} straddle a slice "
+                    f"boundary: inner (ICI) axes map onto slices "
+                    f"{sorted(map(str, row_slices))}. Only "
+                    f"{self.DCN_AXES} may cross slices — shrink the "
+                    f"inner axes to fit one slice or grow dp/pp"
+                )
+
+    def slice_layout(
+        self,
+        devices: Optional[Sequence[jax.Device]] = None,
+        slices: Optional[Sequence[int]] = None,
+    ) -> Dict[str, int]:
+        """{slice id -> device count} for observability/docs."""
+        devs = list(devices) if devices is not None else list(jax.devices())
+        if slices is None:
+            marks = [getattr(d, "slice_index", None) for d in devs]
+        else:
+            marks = list(slices)
+        out: Dict[str, int] = {}
+        for m in marks:
+            out[str(m)] = out.get(str(m), 0) + 1
+        return out
 
     # -- shardings ---------------------------------------------------------
 
