@@ -167,6 +167,11 @@ def make_loss_fn(cfg: BertConfig):
             logits, batch["targets"]
         )
         mask = batch["mask"].astype(jnp.float32)
+        w = batch.get("_w")
+        if w is not None:
+            # runtime real-row weights: padded/replayed rows contribute
+            # zero (models/losses.py contract)
+            mask = mask * w[:, None].astype(jnp.float32)
         return jnp.sum(ce * mask) / jnp.maximum(jnp.sum(mask), 1.0)
 
     return loss_fn

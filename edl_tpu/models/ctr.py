@@ -84,11 +84,14 @@ def make_loss_fn(compute_dtype=jnp.float32):
             params, batch["dense"].astype(compute_dtype), batch["sparse"]
         ).astype(jnp.float32)
         labels = batch["label"].astype(jnp.float32)
-        return jnp.mean(
+        from edl_tpu.models.losses import row_mean
+
+        per_row = (
             jnp.maximum(logits, 0)
             - logits * labels
             + jnp.log1p(jnp.exp(-jnp.abs(logits)))
         )
+        return row_mean(per_row, batch)
 
     return _loss
 

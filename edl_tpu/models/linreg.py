@@ -33,8 +33,10 @@ def predict(params, x: jnp.ndarray) -> jnp.ndarray:
 
 def loss_fn(params, batch) -> jnp.ndarray:
     """Mean squared error (reference: square_error_cost, train_ft.py:93)."""
+    from edl_tpu.models.losses import row_mean
+
     pred = predict(params, batch["x"])
-    return jnp.mean((pred - batch["y"]) ** 2)
+    return row_mean(jnp.mean((pred - batch["y"]) ** 2, axis=-1), batch)
 
 
 def synthetic_dataset(

@@ -408,9 +408,12 @@ def make_loss_fn(cfg: LlamaConfig, plan: Optional[MeshPlan] = None, mesh=None):
             targets = jax.lax.with_sharding_constraint(
                 targets, plan.sequence_sharding(mesh, rank=2)
             )
-        return jnp.mean(
-            optax.softmax_cross_entropy_with_integer_labels(logits, targets)
-        )
+        from edl_tpu.models.losses import row_mean
+
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+        # per-row mean over T, then the runtime's real-row weighting
+        # (identical to the global mean when no "_w" rides the batch)
+        return row_mean(jnp.mean(ce, axis=-1), batch)
 
     return loss_fn
 

@@ -215,9 +215,19 @@ def make_loss_fn(cfg: MoEConfig):
         # materialization
         import optax
 
-        ce = jnp.mean(
-            optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+        from edl_tpu.models.losses import row_mean
+
+        ce = row_mean(
+            jnp.mean(
+                optax.softmax_cross_entropy_with_integer_labels(
+                    logits, targets
+                ),
+                axis=-1,
+            ),
+            batch,
         )
+        # aux (load-balance regularizer over gate statistics) stays
+        # unweighted: it is a router-health term, not a data loss
         return ce + cfg.aux_coef * aux
 
     return loss_fn

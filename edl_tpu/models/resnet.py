@@ -170,10 +170,13 @@ def make_loss_fn(cfg: ResNetConfig):
         logits = forward(params, batch["images"], cfg)
         import optax
 
-        return jnp.mean(
+        from edl_tpu.models.losses import row_mean
+
+        return row_mean(
             optax.softmax_cross_entropy_with_integer_labels(
                 logits, batch["label"]
-            )
+            ),
+            batch,
         )
 
     return loss_fn

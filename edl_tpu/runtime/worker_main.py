@@ -994,24 +994,36 @@ class ElasticWorker:
         """Lease one task; fall back to replaying the previous local
         batch when the queue has no task for us this step (tail rounds —
         coverage still exactly-once via acks; replay only pads the SPMD
-        shape). Returns (local_np_batch, task_id_or_None)."""
+        shape). Returns (local_np_batch, task_id_or_None).
+
+        Every batch carries real-row weights ``_w`` (1 = leased row,
+        0 = wrap-padding / replay / zero filler), consumed by the model
+        losses (models/losses.py row_mean): filler rows keep the SPMD
+        shapes aligned but contribute ZERO gradient, so the update at a
+        ragged tail equals the sequential gradient over real rows."""
         chunk = self._chunk()
         task = cl.lease(self.cfg.worker_id)
         if task is not None:
+            have = task.end - task.start
             local = self._pad_to(batch_fn(task.start, task.end), chunk)
+            w = np.zeros(chunk, np.float32)
+            w[:have] = 1.0
+            local["_w"] = w
             self._last_local = local
             return local, task.task_id
         if self._last_local is not None:
-            return self._last_local, None
+            replay = dict(self._last_local)
+            replay["_w"] = np.zeros(chunk, np.float32)
+            return replay, None
         # first-ever step with no task: zero batch of chunk shape (probe
         # only what the dataset has — a file-backed source bounds-checks,
         # and the dataset may be smaller than one process's rows)
         probe = self._pad_to(
             batch_fn(0, min(chunk, self.cfg.n_samples)), chunk
         )
-        return {
-            k: np.zeros_like(v) for k, v in probe.items()
-        }, None
+        zero = {k: np.zeros_like(v) for k, v in probe.items()}
+        zero["_w"] = np.zeros(chunk, np.float32)
+        return zero, None
 
     def _train_epoch(
         self, cfg, jax, cl, epoch, rank, world, plan, mesh, state, step,
