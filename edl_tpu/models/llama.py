@@ -305,6 +305,24 @@ def forward(
         # ring/ulysses attention is itself a shard_map; nesting it inside
         # the pipeline shard_map is not supported by jax
         raise ValueError("sp and pp cannot be combined in one llama mesh")
+    if (
+        sp == 1  # the pp path also runs the flash kernel per stage
+        and cfg.remat
+        and cfg.remat_policy == "attn"
+        and cfg.use_flash
+    ):
+        from edl_tpu.ops.flash_attention import flash_supported
+
+        if not flash_supported(tokens.shape[1]):
+            # attention() would silently take the dense XLA path, the
+            # flash_out/flash_lse names would never exist, and the
+            # policy would degrade to FULL remat — the exact failure
+            # the use_flash guard in _remat_policy documents
+            raise ValueError(
+                f'remat_policy="attn" needs the flash kernel, but '
+                f"seq len {tokens.shape[1]} is not flash-supported "
+                f"(flash_supported() is False) — pad T or switch policy"
+            )
     if sp > 1 and cfg.remat and cfg.remat_policy == "attn":
         # the sp paths never run the flash kernel, so the flash_out /
         # flash_lse names the policy saves would not exist — the policy

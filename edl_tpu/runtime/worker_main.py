@@ -669,6 +669,18 @@ class ElasticWorker:
                 )
                 client.kv_put(mark(cfg.worker_id), fname)
                 if rank != leader:
+                    # leak guard (ADVICE r2): the leader skips a commit
+                    # when ITS ckpt_step read shows the step already
+                    # committed — and since the skip is decided on this
+                    # same shared KV, one fresh read here sees it too.
+                    # In that case nobody will collect this mark:
+                    # reclaim it now. The healthy path (leader waiting
+                    # on marks) stays fire-and-forget.
+                    if (
+                        int(client.kv_get(self._k("ckpt_step")) or "-1")
+                        >= snap.step
+                    ):
+                        client.kv_del(mark(cfg.worker_id))
                     return
                 # scale the commit deadline with shard size is the
                 # caller's job (EDL_CKPT_COMMIT_TIMEOUT_S); the default
