@@ -1,0 +1,75 @@
+"""Blockwise int8 quantization — optimizer-moment staging compression.
+
+The host-fallback reshard (and nothing else on the hot path) moves the
+full TrainState through host RAM at host-link bandwidth; optimizer
+moments are 2/3 of an Adam state's bytes. 8-bit optimizer states with
+blockwise absmax scaling are established practice (the 8-bit-Adam
+recipe: quantize per block against the block's absmax so outliers
+cannot flatten the rest), and a reshard staging round-trip is even
+safer than a persistent 8-bit optimizer — the f32 master moments are
+only perturbed once per rescale, by at most 1/254 of their block's
+absmax. Params are never quantized (master weights stay exact).
+
+Blocks are the LAST axis of each leaf (row-wise for matrices): scale
+tensors are ``shape[:-1]`` f32 — 1/last_dim of the leaf's bytes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def quantize_int8(x: jnp.ndarray):
+    """x f32 [..., D] -> (q int8 [..., D], scale f32 [...])."""
+    m = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+    s = jnp.where(m > 0, m / 127.0, 1.0)
+    q = jnp.clip(jnp.round(x / s), -127, 127).astype(jnp.int8)
+    return q, s[..., 0]
+
+
+def dequantize_int8(q: jnp.ndarray, s: jnp.ndarray, dtype=jnp.float32):
+    return q.astype(dtype) * s[..., None].astype(dtype)
+
+
+_quantize_jit = None
+_dequant_cache = {}
+_cast_cache = {}
+
+
+def cast_to(x, dtype):
+    """Cached-jit dtype cast (the bf16 staging mode's down/up casts —
+    per-call jit objects would re-trace each reshard)."""
+    key = jnp.dtype(dtype).name
+    fn = _cast_cache.get(key)
+    if fn is None:
+        fn = jax.jit(lambda a: a.astype(dtype))
+        _cast_cache[key] = fn
+    return fn(x)
+
+
+def quantize_on_device(x):
+    """Jit-compiled quantize where ``x`` lives (the source mesh of a
+    reshard): q inherits x's sharding, the scale tensor follows its
+    leading dims. One cached jit serves every leaf (per-call jit
+    objects would re-trace each reshard)."""
+    global _quantize_jit
+    if _quantize_jit is None:
+        _quantize_jit = jax.jit(quantize_int8)
+    return _quantize_jit(x)
+
+
+def dequantize_to(q, s, sharding, dtype=jnp.float32):
+    """Jit-compiled dequantize placed directly into ``sharding`` on the
+    target mesh (jit cached per target sharding)."""
+    key = (sharding, jnp.dtype(dtype).name)
+    fn = _dequant_cache.get(key)
+    if fn is None:
+        fn = jax.jit(
+            lambda qq, ss: dequantize_int8(qq, ss, dtype),
+            out_shardings=sharding,
+        )
+        if len(_dequant_cache) > 256:  # old meshes die across reshards
+            _dequant_cache.clear()
+        _dequant_cache[key] = fn
+    return fn(q, s)

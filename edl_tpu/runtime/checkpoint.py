@@ -32,6 +32,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from edl_tpu.parallel import sharding as shd
@@ -57,22 +58,90 @@ def restore(
 
 
 def staged_reshard(
-    state: TrainState, plan: MeshPlan, mesh, param_pspecs=None
+    state: TrainState, plan: MeshPlan, mesh, param_pspecs=None,
+    stage: Optional[str] = None,
 ) -> TrainState:
     """Device → host → device as ONE overlapped pipeline — the host
     fallback of the reshard protocol when ``snapshot`` + ``restore``
     would run the two transfer directions back to back. Delegates to
     :func:`edl_tpu.parallel.sharding.stream_reshard` (shared window and
     piece policies with ``to_host``); the sum-form snapshot/restore
-    pair remains for disk checkpoints."""
+    pair remains for disk checkpoints.
+
+    ``stage`` compresses the OPTIMIZER-MOMENT leaves (never params — the
+    f32 master weights move exactly) for the host round trip:
+
+    - ``"int8"`` (default, env ``EDL_RESHARD_STAGE``): blockwise-absmax
+      int8 (ops/quant.py, the 8-bit-Adam staging recipe) — Adam state
+      bytes 3P → ~1.5P, halving the fallback stall. Moments perturb by
+      ≤ 1/254 of their block absmax, once per rescale.
+    - ``"bf16"``: device-side cast, 3P → 2P, exponent-exact.
+    - ``"f32"``: no compression (bit-identical staging).
+    """
     from edl_tpu.train.trainer import state_pspecs
 
+    stage = stage or os.environ.get("EDL_RESHARD_STAGE", "int8")
+    if stage not in ("int8", "bf16", "f32"):
+        raise ValueError(f"unknown reshard staging mode {stage!r}")
     sharding_tree = shd.named(state_pspecs(state, plan, param_pspecs), mesh)
     leaves, treedef = jax.tree_util.tree_flatten(state)
     sh_leaves = treedef.flatten_up_to(sharding_tree)
-    return jax.tree_util.tree_unflatten(
-        treedef, shd.stream_reshard(leaves, sh_leaves)
-    )
+
+    # moment leaves = everything in opt_state (flatten order: the
+    # TrainState fields in declaration order — step, params, opt_state)
+    n_pre = 1 + len(jax.tree_util.tree_leaves(state.params))
+
+    def _compressible(i, x) -> bool:
+        return (
+            stage != "f32"
+            and i >= n_pre
+            and getattr(x, "dtype", None) == jnp.float32
+            and getattr(x, "ndim", 0) >= 1
+            and getattr(x, "size", 0) >= 4096
+        )
+
+    if stage == "f32" or not any(
+        _compressible(i, x) for i, x in enumerate(leaves)
+    ):
+        return jax.tree_util.tree_unflatten(
+            treedef, shd.stream_reshard(leaves, sh_leaves)
+        )
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from edl_tpu.ops import quant
+
+    moved, moved_sh, plan_ops = [], [], []
+    for i, (x, sh) in enumerate(zip(leaves, sh_leaves)):
+        if not _compressible(i, x):
+            plan_ops.append(("raw", len(moved)))
+            moved.append(x)
+            moved_sh.append(sh)
+        elif stage == "bf16":
+            plan_ops.append(("bf16", len(moved)))
+            moved.append(quant.cast_to(x, jnp.bfloat16))
+            moved_sh.append(sh)
+        else:  # int8
+            q, s = quant.quantize_on_device(x)
+            plan_ops.append(("int8", len(moved), sh))
+            moved.append(q)
+            moved_sh.append(sh)
+            # scales are shape[:-1] f32 (1/last_dim of the leaf bytes):
+            # replicated placement is cheap and always divides
+            moved.append(s)
+            moved_sh.append(NamedSharding(mesh, P()))
+    placed = shd.stream_reshard(moved, moved_sh)
+
+    out = []
+    for op in plan_ops:
+        if op[0] == "raw":
+            out.append(placed[op[1]])
+        elif op[0] == "bf16":
+            out.append(quant.cast_to(placed[op[1]], jnp.float32))
+        else:
+            j, sh = op[1], op[2]
+            out.append(quant.dequantize_to(placed[j], placed[j + 1], sh))
+    return jax.tree_util.tree_unflatten(treedef, out)
 
 
 def state_nbytes(state) -> int:
@@ -82,28 +151,50 @@ def state_nbytes(state) -> int:
     )
 
 
+STAGE_MOMENT_FACTOR = {"f32": 1.0, "bf16": 0.5, "int8": 0.26}
+
+
 def host_fallback_stall_model(
-    state_bytes: int, hosts_after: int, host_bw_bytes_s: float
+    state_bytes: int,
+    hosts_after: int,
+    host_bw_bytes_s: float,
+    moment_bytes: int = 0,
+    stage: str = "f32",
 ) -> float:
     """Worst-case host-staged reshard stall, in seconds.
 
     The fallback moves state through host RAM when no device path
     exists (disjoint device sets — e.g. a slice swap). Each surviving
-    host must ingest its share of the FULL post-reshard state,
-    ``state_bytes / hosts_after``, through its own host<->device link;
-    with the overlapped down/up pipeline (sharding.stream_reshard) the
-    stall is ~max(d2h, h2d) ≈ one direction's bytes over the link
-    bandwidth. Shrinks are the worst case: fewer hosts absorb the same
-    total state (the v5e-64 → v5e-4 shrink in BASELINE.md concentrates
-    16x the per-host bytes). ``host_bw_bytes_s`` is the measured
-    single-host streaming bandwidth — bench.py derives it from the
-    flagship staged-reshard measurement and evaluates this model as
-    ``stall_model_8b_1host_s``; doc/reshard_stall.md carries the full
-    derivation and the <30 s budget check.
+    host must ingest its share of the FULL post-reshard state through
+    its own host<->device link; with the overlapped down/up pipeline
+    (sharding.stream_reshard) the stall is ~max(d2h, h2d) ≈ one
+    direction's bytes over the link bandwidth. Shrinks are the worst
+    case: fewer hosts absorb the same total state (the v5e-64 → v5e-4
+    shrink in BASELINE.md concentrates 16x the per-host bytes).
+
+    ``moment_bytes``/``stage`` model the optimizer-moment staging
+    compression of :func:`staged_reshard`: wire bytes =
+    (state - moments) + moments·factor, where the int8 factor 0.26 is
+    1/4 payload + ~1/D scale overhead. Params always move at full
+    fidelity — an Adam state (moments = 2/3 of bytes) halves its stall
+    under int8 staging, while an adafactor state (factored moments,
+    params-dominated) barely moves, and the model says so honestly.
+    ``host_bw_bytes_s`` must be the RAW link bandwidth (derived from an
+    UNCOMPRESSED staging measurement — bench.py's f32 run); the model
+    is evaluated as ``stall_model_8b_1host_s``; doc/reshard_stall.md
+    carries the derivation and the <30 s budget check.
     """
     if hosts_after <= 0 or host_bw_bytes_s <= 0:
         raise ValueError("hosts_after and host_bw_bytes_s must be positive")
-    return (state_bytes / hosts_after) / host_bw_bytes_s
+    if stage not in STAGE_MOMENT_FACTOR:
+        raise ValueError(f"unknown reshard staging mode {stage!r}")
+    if not 0 <= moment_bytes <= state_bytes:
+        raise ValueError(
+            f"moment_bytes {moment_bytes} outside [0, {state_bytes}]"
+        )
+    factor = STAGE_MOMENT_FACTOR[stage]
+    wire = (state_bytes - moment_bytes) + moment_bytes * factor
+    return (wire / hosts_after) / host_bw_bytes_s
 
 
 # -- disk format -------------------------------------------------------------
