@@ -16,23 +16,18 @@ vs_baseline is 1.0: the reference publishes no throughput numbers
 being established for later rounds.
 """
 
-import getpass
 import json
-import os
-import tempfile
 import time
 
 import jax
 
 # persistent compilation cache: the sorted-blockmatmul embedding
 # backward is expensive to compile (~1-2 min); repeated bench runs on
-# the same machine hit the cache and skip it. Per-user path: a fixed
-# /tmp name breaks (and is poisonable) on shared hosts.
-_cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
-    tempfile.gettempdir(), f"edl_jax_cache_{getpass.getuser()}"
-)
-jax.config.update("jax_compilation_cache_dir", _cache_dir)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+# the same machine hit the cache and skip it (shared policy:
+# edl_tpu/utils/jaxcache.py)
+from edl_tpu.utils import jaxcache
+
+jaxcache.configure()
 import jax.numpy as jnp
 import numpy as np
 import optax

@@ -488,6 +488,17 @@ def flash_supported(t: int, block_q: int = 512, block_k: int = 1024) -> bool:
 
 def attention_auto(q, k, v, causal: bool = True):
     """flash_attention on TPU; interpret-mode pallas elsewhere (tiny
-    shapes only — tests)."""
+    shapes only — tests). Block sizes are sequence-length-tuned,
+    measured on v5e for BOTH directions: at T=2048 (512, 1024) is
+    fastest (fwd 11.6 vs 10.7 TF/s for square blocks); at T=8192
+    square 1024 blocks win fwd +12% (41.6 vs 37.1) and fwd+bwd +1.5%
+    (46.1 vs 45.4), and the full T8192 train step (fwd x2 + bwd under
+    remat) improves 13,945 -> 14,365 tok/s — longer rows amortize the
+    per-block softmax reduces better."""
     on_tpu = jax.devices()[0].platform == "tpu"
-    return flash_attention(q, k, v, causal=causal, interpret=not on_tpu)
+    t = q.shape[1]
+    bq, bk = (1024, 1024) if t >= 4096 else (512, 1024)
+    return flash_attention(
+        q, k, v, causal=causal, block_q=bq, block_k=bk,
+        interpret=not on_tpu,
+    )

@@ -87,14 +87,15 @@ def staged_reshard(
     leaves, treedef = jax.tree_util.tree_flatten(state)
     sh_leaves = treedef.flatten_up_to(sharding_tree)
 
-    # moment leaves = everything in opt_state (flatten order: the
-    # TrainState fields in declaration order — step, params, opt_state)
-    n_pre = 1 + len(jax.tree_util.tree_leaves(state.params))
+    # moment leaves = exactly the opt_state subtree, identified by
+    # object identity (NOT flatten position — a future TrainState field
+    # must never silently fall into the lossy-compression set)
+    opt_ids = {id(x) for x in jax.tree_util.tree_leaves(state.opt_state)}
 
     def _compressible(i, x) -> bool:
         return (
             stage != "f32"
-            and i >= n_pre
+            and id(x) in opt_ids
             and getattr(x, "dtype", None) == jnp.float32
             and getattr(x, "ndim", 0) >= 1
             and getattr(x, "size", 0) >= 4096

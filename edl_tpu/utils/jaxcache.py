@@ -1,0 +1,28 @@
+"""Persistent-compilation-cache setup shared by every benchmark entry
+point (bench.py, scripts/*.py) — ONE place for the cache policy, so no
+probe silently runs with a cold or mismatched cache (the exact
+cross-run-variance failure the probes exist to rule out).
+
+Call :func:`configure` right after ``import jax`` and before any
+compilation. Per-user path: a fixed /tmp name breaks (and is
+poisonable) on shared hosts.
+"""
+
+from __future__ import annotations
+
+import getpass
+import os
+import tempfile
+
+
+def configure(min_compile_time_s: float = 2.0) -> str:
+    import jax
+
+    cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
+        tempfile.gettempdir(), f"edl_jax_cache_{getpass.getuser()}"
+    )
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs", min_compile_time_s
+    )
+    return cache_dir

@@ -96,6 +96,24 @@ kubectl -n "${JOB_NS}" get tj "${JOB}" -o jsonpath='{.status}' | python3 -m json
 say "controller logs (tail)"
 kubectl -n "${NS_SYS}" logs deploy/edl-controller --tail=40 || true
 
+# the controller sources TrainingJobs over a streaming watch
+# (cluster/kube.py KubeJobSource) with list-diff fallback; against a
+# REAL apiserver the log must NOT show repeated fallback warnings —
+# that would mean the watch contract (resourceVersion resume, 410
+# handling) drifted from the fake the tests validate against
+say "watch health: no repeated 'watch stream broke' fallbacks expected"
+watch_breaks=$(kubectl -n "${NS_SYS}" logs deploy/edl-controller --tail=200 \
+  | grep -c "watch stream broke" || true)
+if [[ -z "${watch_breaks}" ]]; then
+  echo "WARN: could not read controller logs for the watch-health check"
+elif (( watch_breaks > 2 )); then
+  echo "FAIL: ${watch_breaks} watch-stream fallbacks in the last 200 log lines"
+  echo "      (the streaming watch contract drifted from the real apiserver)"
+  exit 1
+else
+  echo "watch health ok (${watch_breaks} fallbacks)"
+fi
+
 say "collector snapshot (edl monitor, one poll)"
 kubectl -n "${JOB_NS}" get tj -o wide
 kubectl -n "${JOB_NS}" get pods -l "edl-job=${JOB}" -o wide

@@ -20,11 +20,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 
-_cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
-    tempfile.gettempdir(), f"edl_jax_cache_{getpass.getuser()}"
-)
-jax.config.update("jax_compilation_cache_dir", _cache_dir)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+from edl_tpu.utils import jaxcache
+
+jaxcache.configure()
 import jax.numpy as jnp
 import numpy as np
 import optax
