@@ -16,7 +16,6 @@ SURVEY §5); built TPU-first:
 from __future__ import annotations
 
 from dataclasses import dataclass
-from functools import partial
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -175,11 +174,17 @@ def _rmsnorm(x: jnp.ndarray, scale: jnp.ndarray, eps: float) -> jnp.ndarray:
     return (x * jax.lax.rsqrt(var + eps)).astype(x.dtype) * scale.astype(x.dtype)
 
 
-def _rope(x: jnp.ndarray, theta: float) -> jnp.ndarray:
-    """Rotary embedding over [B, T, H, hd]."""
+def _rope(
+    x: jnp.ndarray, theta: float, positions: Optional[jnp.ndarray] = None
+) -> jnp.ndarray:
+    """Rotary embedding over [B, T, H, hd]. ``positions`` [T] overrides
+    the default 0..T-1 (the decode path rotates single tokens at their
+    absolute position)."""
     _, t, _, hd = x.shape
     freqs = 1.0 / (theta ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd))
-    angles = jnp.arange(t, dtype=jnp.float32)[:, None] * freqs[None, :]  # [T, hd/2]
+    if positions is None:
+        positions = jnp.arange(t, dtype=jnp.float32)
+    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # [T, hd/2]
     cos = jnp.cos(angles)[None, :, None, :].astype(x.dtype)
     sin = jnp.sin(angles)[None, :, None, :].astype(x.dtype)
     x1, x2 = jnp.split(x, 2, axis=-1)
@@ -232,25 +237,50 @@ def attention(
     return jnp.einsum("bhts,bshd->bthd", probs, v)
 
 
-def _layer(
-    cfg: LlamaConfig, x: jnp.ndarray, lp: Dict, mesh=None, sp: int = 1
-) -> jnp.ndarray:
-    b, t, d = x.shape
+def _qkv(cfg: LlamaConfig, a: jnp.ndarray, lp: Dict, positions=None):
+    """Projections + RoPE — shared by the training layer and the
+    KV-cache decode so the model math cannot diverge between them."""
+    b, t, _ = a.shape
     h, kv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    dt = x.dtype
-    # attention block
-    a = _rmsnorm(x, lp["ln1"], cfg.norm_eps)
+    dt = a.dtype
     q = (a @ lp["wq"].astype(dt)).reshape(b, t, h, hd)
     k = (a @ lp["wk"].astype(dt)).reshape(b, t, kv, hd)
     v = (a @ lp["wv"].astype(dt)).reshape(b, t, kv, hd)
-    q, k = _rope(q, cfg.rope_theta), _rope(k, cfg.rope_theta)
-    o = attention(q, k, v, cfg, mesh=mesh, sp=sp).reshape(b, t, h * hd)
-    x = x + o @ lp["wo"].astype(dt)
-    # mlp block (SwiGLU)
+    q = _rope(q, cfg.rope_theta, positions)
+    k = _rope(k, cfg.rope_theta, positions)
+    return q, k, v
+
+
+def _mlp(cfg: LlamaConfig, x: jnp.ndarray, lp: Dict) -> jnp.ndarray:
+    """Post-attention SwiGLU block (residual included) — shared by the
+    training layer and the decode step."""
+    dt = x.dtype
     m = _rmsnorm(x, lp["ln2"], cfg.norm_eps)
     gate = checkpoint_name(jax.nn.silu(m @ lp["w1"].astype(dt)), "mlp_gate")
     up = checkpoint_name(m @ lp["w3"].astype(dt), "mlp_up")
     return x + (gate * up) @ lp["w2"].astype(dt)
+
+
+def _layer(
+    cfg: LlamaConfig,
+    x: jnp.ndarray,
+    lp: Dict,
+    mesh=None,
+    sp: int = 1,
+    with_kv: bool = False,
+):
+    """One decoder layer. ``with_kv`` also returns this layer's (k, v)
+    — the prefill path collects them into the decode cache; the
+    training path must NOT set it (materializing every layer's K/V
+    across the scan costs O(L·B·T) HBM)."""
+    b, t, d = x.shape
+    dt = x.dtype
+    a = _rmsnorm(x, lp["ln1"], cfg.norm_eps)
+    q, k, v = _qkv(cfg, a, lp)
+    o = attention(q, k, v, cfg, mesh=mesh, sp=sp).reshape(b, t, -1)
+    x = x + o @ lp["wo"].astype(dt)
+    out = _mlp(cfg, x, lp)
+    return (out, k, v) if with_kv else out
 
 
 def _remat_policy(cfg: LlamaConfig):
@@ -382,6 +412,142 @@ def forward(
         x, _ = jax.lax.scan(body, x, params["layers"])
     x = _rmsnorm(x, params["ln_f"], cfg.norm_eps)
     return (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+
+
+# -- inference: KV-cache decode ---------------------------------------------
+#
+# The serving half of the export story (runtime/export.py publishes the
+# params; this consumes them). TPU-first: prefill is one full forward
+# whose per-layer K/V are collected by the SAME lax.scan that runs the
+# layers, and the decode loop is a single lax.scan over positions with
+# the cache as carry — one compiled program for the whole generation,
+# no per-token dispatch, static [B, max_len] shapes throughout.
+
+
+def _prefill(params: Dict, tokens: jnp.ndarray, cfg: LlamaConfig):
+    """Forward over the prompt, returning (logits_last [B, V],
+    k_cache, v_cache [L, B, T, KV, hd]). Runs the SAME ``_layer`` as
+    training (``with_kv=True`` collects the cache)."""
+    x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
+
+    def body(carry, lp):
+        y, k, v = _layer(cfg, carry, lp, with_kv=True)
+        return y, (k, v)
+
+    x, (ks, vs) = jax.lax.scan(body, x, params["layers"])
+    x = _rmsnorm(x, params["ln_f"], cfg.norm_eps)
+    logits = (x[:, -1] @ params["lm_head"].astype(cfg.dtype)).astype(
+        jnp.float32
+    )
+    return logits, ks, vs
+
+
+def _decode_step(params: Dict, tok: jnp.ndarray, pos, kc, vc, cfg: LlamaConfig):
+    """One cached decode step. tok [B] int32; kc/vc [L, B, S, KV, hd]
+    (S = max_len); pos = index this token writes. Returns
+    (logits [B, V], kc, vc)."""
+    b = tok.shape[0]
+    h, kv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    groups = h // kv
+    s = kc.shape[2]
+    x = jnp.take(params["embed"], tok[:, None], axis=0).astype(cfg.dtype)
+    positions = jnp.full((1,), pos)
+
+    def body(carry, layer):
+        xx = carry
+        lp, kci, vci = layer
+        dt = xx.dtype
+        a = _rmsnorm(xx, lp["ln1"], cfg.norm_eps)
+        # same projections/RoPE as training (_qkv); only the
+        # cache-update + masked-dense attention differ by construction
+        q, knew, vnew = _qkv(cfg, a, lp, positions)
+        kci = jax.lax.dynamic_update_slice_in_dim(kci, knew, pos, axis=1)
+        vci = jax.lax.dynamic_update_slice_in_dim(vci, vnew, pos, axis=1)
+        kk = jnp.repeat(kci, groups, axis=2)
+        vv = jnp.repeat(vci, groups, axis=2)
+        scores = jnp.einsum("bthd,bshd->bhts", q, kk) / np.sqrt(hd)
+        mask = (jnp.arange(s) <= pos)[None, None, None, :]
+        scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dt)
+        o = jnp.einsum("bhts,bshd->bthd", probs, vv).reshape(b, 1, h * hd)
+        xx = xx + o @ lp["wo"].astype(dt)
+        return _mlp(cfg, xx, lp), (kci, vci)
+
+    x, (kc, vc) = jax.lax.scan(body, x, (params["layers"], kc, vc))
+    x = _rmsnorm(x, params["ln_f"], cfg.norm_eps)
+    logits = (x[:, 0] @ params["lm_head"].astype(cfg.dtype)).astype(
+        jnp.float32
+    )
+    return logits, kc, vc
+
+
+def generate(
+    params: Dict,
+    tokens: jnp.ndarray,
+    cfg: LlamaConfig,
+    max_new: int,
+    temperature: float = 0.0,
+    key: Optional[jax.Array] = None,
+) -> jnp.ndarray:
+    """Autoregressive generation from a prompt [B, T0] → [B, max_new].
+
+    Greedy at ``temperature == 0`` (the default), categorical sampling
+    otherwise (``key`` required). One jit per (shape, cfg): prefill +
+    a ``lax.scan`` decode loop over positions with the KV cache as
+    carry. Accepts params straight from ``runtime.export.load_export``
+    (cast float leaves to ``cfg.dtype``-compatible types first if the
+    export was bf16 and you want f32 math)."""
+    if temperature > 0 and key is None:
+        raise ValueError("sampling (temperature > 0) needs a PRNG key")
+    b, t0 = tokens.shape
+    run = _generate_program(cfg, b, t0, int(max_new), float(temperature))
+    return run(params, tokens, key if key is not None else jax.random.PRNGKey(0))
+
+
+_generate_programs: Dict = {}
+
+
+def _generate_program(cfg: LlamaConfig, b: int, t0: int, max_new: int,
+                      temperature: float):
+    """Memoized jit program per (cfg, shapes, temperature) — repeat
+    generate() calls with the same signature reuse the compiled
+    prefill+decode scan instead of re-tracing (a full-size model pays
+    minutes per compile)."""
+    cache_key = (cfg, b, t0, max_new, temperature)
+    run = _generate_programs.get(cache_key)
+    if run is not None:
+        return run
+    kvh, hd, L = cfg.n_kv_heads, cfg.head_dim, cfg.n_layers
+    max_len = t0 + max_new
+
+    @jax.jit
+    def run(params, tokens, key):
+        logits, ks, vs = _prefill(params, tokens, cfg)
+        pad = jnp.zeros((L, b, max_len - t0, kvh, hd), ks.dtype)
+        kc = jnp.concatenate([ks, pad], axis=2)
+        vc = jnp.concatenate([vs, pad], axis=2)
+
+        def sample(logits, k):
+            if temperature > 0:
+                return jax.random.categorical(k, logits / temperature, axis=-1)
+            return jnp.argmax(logits, axis=-1)
+
+        def step(carry, i):
+            logits, kc, vc, k = carry
+            k, sub = jax.random.split(k)
+            tok = sample(logits, sub).astype(jnp.int32)
+            logits, kc, vc = _decode_step(params, tok, t0 + i, kc, vc, cfg)
+            return (logits, kc, vc, k), tok
+
+        (_, _, _, _), toks = jax.lax.scan(
+            step, (logits, kc, vc, key), jnp.arange(max_new)
+        )
+        return jnp.swapaxes(toks, 0, 1)  # [B, max_new]
+
+    if len(_generate_programs) > 64:
+        _generate_programs.clear()
+    _generate_programs[cache_key] = run
+    return run
 
 
 def train_flops_per_token(cfg: LlamaConfig, seq: int) -> float:

@@ -27,7 +27,9 @@ def main() -> int:
     ap.add_argument(
         "--export-dir",
         default="",
-        help="publish a servable bf16 params-only export at the end",
+        help="publish a servable float32 params-only export at the end "
+        "and decode a sample from it (production jobs export bf16 via "
+        "EDL_EXPORT_DTYPE; f32 here keeps the tiny demo's decode exact)",
     )
     ap.add_argument(
         "--mesh",
@@ -85,10 +87,18 @@ def main() -> int:
 
     assert int(state.step) == args.steps
     if args.export_dir:
-        from edl_tpu.runtime.export import export_params
+        from edl_tpu.runtime.export import export_params, load_export
 
-        d = export_params(args.export_dir, state.params, int(state.step))
+        d = export_params(
+            args.export_dir, state.params, int(state.step), dtype="float32"
+        )
         print(f"export published: {d}")
+        # the serving round trip: a consumer loads ONLY the export and
+        # decodes with the KV cache (llama.generate)
+        served, _ = load_export(args.export_dir)
+        prompt = np.asarray([[1, 2, 3, 4]], np.int32)
+        toks = llama.generate(served, prompt, cfg, max_new=8)
+        print(f"generated from export: {np.asarray(toks)[0].tolist()}")
     print("ok")
     return 0
 

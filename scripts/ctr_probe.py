@@ -9,11 +9,9 @@ in one process to quantify run-to-run spread on the tunneled chip.
 Run: python scripts/ctr_probe.py [N]
 """
 
-import getpass
 import json
 import os
 import sys
-import tempfile
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

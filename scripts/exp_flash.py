@@ -10,8 +10,6 @@ import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-import getpass
-import tempfile
 
 import jax
 
