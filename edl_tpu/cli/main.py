@@ -370,6 +370,57 @@ def run_export_status(args) -> int:
     return 0
 
 
+def run_generate(args) -> int:
+    """Decode from a published export — the serving consumer in one
+    command (export manifest carries the architecture record; llama
+    KV-cache decode does the rest). Imports jax lazily: every other CLI
+    verb stays device-free."""
+    import numpy as np
+
+    from edl_tpu.runtime.export import load_export
+
+    params, doc = load_export(args.export_dir)
+    model = doc.get("model") or {}
+    if model.get("family") != "llama":
+        print(
+            f"export has no llama architecture record "
+            f"(model={model or None}); re-export with model_meta "
+            f"(LlamaConfig.to_meta())",
+            file=sys.stderr,
+        )
+        return 1
+    import jax
+
+    from edl_tpu.models import llama
+
+    cfg = llama.LlamaConfig.from_meta(model)
+    try:
+        ids = [int(t) for t in args.prompt.split(",")]
+    except ValueError:
+        print(
+            f"--prompt must be comma-separated integers, got {args.prompt!r}",
+            file=sys.stderr,
+        )
+        return 1
+    if not ids or args.max_new < 1:
+        print("need a non-empty prompt and --max-new >= 1", file=sys.stderr)
+        return 1
+    prompt = np.asarray([ids], np.int32)
+    if (prompt < 0).any() or (prompt >= cfg.vocab).any():
+        print(f"prompt tokens outside [0, {cfg.vocab})", file=sys.stderr)
+        return 1
+    toks = llama.generate(
+        params,
+        prompt,
+        cfg,
+        max_new=args.max_new,
+        temperature=args.temperature,
+        key=jax.random.PRNGKey(args.seed) if args.temperature > 0 else None,
+    )
+    print(",".join(str(int(t)) for t in np.asarray(toks)[0]))
+    return 0
+
+
 def run_validate(args) -> int:
     try:
         job = TrainingJob.from_yaml_file(args.manifest)
@@ -509,6 +560,18 @@ def build_parser() -> argparse.ArgumentParser:
         "--fetch", default=None, help="copy the latest export to this dir"
     )
     ex.set_defaults(fn=run_export_status)
+
+    g = sub.add_parser(
+        "generate", help="decode tokens from a published llama export"
+    )
+    g.add_argument("export_dir")
+    g.add_argument(
+        "--prompt", required=True, help="comma-separated token ids"
+    )
+    g.add_argument("--max-new", type=int, default=16)
+    g.add_argument("--temperature", type=float, default=0.0)
+    g.add_argument("--seed", type=int, default=0)
+    g.set_defaults(fn=run_generate)
 
     return p
 

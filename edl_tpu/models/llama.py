@@ -70,6 +70,40 @@ class LlamaConfig:
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
 
+    def to_meta(self) -> Dict:
+        """JSON-safe architecture record (rides export manifests so a
+        serving consumer can rebuild the config; runtime/export.py)."""
+        return {
+            "family": "llama",
+            "vocab": self.vocab,
+            "d_model": self.d_model,
+            "n_layers": self.n_layers,
+            "n_heads": self.n_heads,
+            "n_kv_heads": self.n_kv_heads,
+            "d_ff": self.d_ff,
+            "rope_theta": self.rope_theta,
+            "norm_eps": self.norm_eps,
+            "dtype": jnp.dtype(self.dtype).name,
+            "use_flash": self.use_flash,
+        }
+
+    @classmethod
+    def from_meta(cls, meta: Dict) -> "LlamaConfig":
+        if meta.get("family") != "llama":
+            raise ValueError(f"not a llama export: family={meta.get('family')!r}")
+        return cls(
+            vocab=int(meta["vocab"]),
+            d_model=int(meta["d_model"]),
+            n_layers=int(meta["n_layers"]),
+            n_heads=int(meta["n_heads"]),
+            n_kv_heads=int(meta["n_kv_heads"]),
+            d_ff=int(meta["d_ff"]),
+            rope_theta=float(meta["rope_theta"]),
+            norm_eps=float(meta["norm_eps"]),
+            dtype=jnp.dtype(meta["dtype"]),
+            use_flash=bool(meta.get("use_flash", False)),
+        )
+
     @classmethod
     def llama3_8b(cls) -> "LlamaConfig":
         return cls()
@@ -463,13 +497,15 @@ def _decode_step(params: Dict, tok: jnp.ndarray, pos, kc, vc, cfg: LlamaConfig):
         q, knew, vnew = _qkv(cfg, a, lp, positions)
         kci = jax.lax.dynamic_update_slice_in_dim(kci, knew, pos, axis=1)
         vci = jax.lax.dynamic_update_slice_in_dim(vci, vnew, pos, axis=1)
-        kk = jnp.repeat(kci, groups, axis=2)
-        vv = jnp.repeat(vci, groups, axis=2)
-        scores = jnp.einsum("bthd,bshd->bhts", q, kk) / np.sqrt(hd)
-        mask = (jnp.arange(s) <= pos)[None, None, None, :]
+        # GQA-native: group the query heads against the un-repeated
+        # cache (as the flash kernel does) — no groups-fold bandwidth
+        # multiplier on the token-latency-critical path
+        qg = q.reshape(b, 1, kv, groups, hd)
+        scores = jnp.einsum("btkgd,bskd->bkgts", qg, kci) / np.sqrt(hd)
+        mask = (jnp.arange(s) <= pos)[None, None, None, None, :]
         scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
         probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dt)
-        o = jnp.einsum("bhts,bshd->bthd", probs, vv).reshape(b, 1, h * hd)
+        o = jnp.einsum("bkgts,bskd->btkgd", probs, vci).reshape(b, 1, h * hd)
         xx = xx + o @ lp["wo"].astype(dt)
         return _mlp(cfg, xx, lp), (kci, vci)
 
@@ -499,21 +535,28 @@ def generate(
     export was bf16 and you want f32 math)."""
     if temperature > 0 and key is None:
         raise ValueError("sampling (temperature > 0) needs a PRNG key")
+    if max_new < 1:
+        raise ValueError(f"max_new must be >= 1, got {max_new}")
     b, t0 = tokens.shape
-    run = _generate_program(cfg, b, t0, int(max_new), float(temperature))
-    return run(params, tokens, key if key is not None else jax.random.PRNGKey(0))
+    run = _generate_program(cfg, b, t0, int(max_new), temperature > 0)
+    return run(
+        params,
+        tokens,
+        key if key is not None else jax.random.PRNGKey(0),
+        jnp.float32(temperature if temperature > 0 else 1.0),
+    )
 
 
 _generate_programs: Dict = {}
 
 
 def _generate_program(cfg: LlamaConfig, b: int, t0: int, max_new: int,
-                      temperature: float):
-    """Memoized jit program per (cfg, shapes, temperature) — repeat
-    generate() calls with the same signature reuse the compiled
-    prefill+decode scan instead of re-tracing (a full-size model pays
-    minutes per compile)."""
-    cache_key = (cfg, b, t0, max_new, temperature)
+                      sampling: bool):
+    """Memoized jit program per (cfg, shapes, greedy-vs-sampling) —
+    repeat generate() calls reuse the compiled prefill+decode scan
+    instead of re-tracing (a full-size model pays minutes per compile).
+    Temperature is a TRACED scalar: sweeping it costs zero recompiles."""
+    cache_key = (cfg, b, t0, max_new, sampling)
     run = _generate_programs.get(cache_key)
     if run is not None:
         return run
@@ -521,14 +564,14 @@ def _generate_program(cfg: LlamaConfig, b: int, t0: int, max_new: int,
     max_len = t0 + max_new
 
     @jax.jit
-    def run(params, tokens, key):
+    def run(params, tokens, key, temperature):
         logits, ks, vs = _prefill(params, tokens, cfg)
         pad = jnp.zeros((L, b, max_len - t0, kvh, hd), ks.dtype)
         kc = jnp.concatenate([ks, pad], axis=2)
         vc = jnp.concatenate([vs, pad], axis=2)
 
         def sample(logits, k):
-            if temperature > 0:
+            if sampling:
                 return jax.random.categorical(k, logits / temperature, axis=-1)
             return jnp.argmax(logits, axis=-1)
 

@@ -75,6 +75,7 @@ def _write_export(
     flat: Dict[str, np.ndarray],
     dtype: str,
     source: str,
+    model: Optional[Dict[str, Any]] = None,
 ) -> str:
     d = os.path.join(root, f"step-{step:08d}")
     os.makedirs(d, exist_ok=True)
@@ -100,6 +101,10 @@ def _write_export(
                 "dtypes": dtypes,
                 "shapes": shapes,
                 "source": source,
+                # architecture record (e.g. LlamaConfig.to_meta()):
+                # lets a consumer rebuild the model without the repo
+                # config that trained it
+                "model": model or {},
             },
             f,
         )
@@ -147,6 +152,7 @@ def export_params(
     step: int,
     dtype: str = "bfloat16",
     source: str = "in-process",
+    model_meta: Optional[Dict[str, Any]] = None,
 ) -> str:
     """Export an in-process (possibly device-resident) param tree.
     Returns the export step directory."""
@@ -155,11 +161,12 @@ def export_params(
     flat = {}
     for key, leaf in _leaf_keys(params):
         flat[key] = np.asarray(jax.device_get(leaf))
-    return _write_export(root, step, flat, dtype, source)
+    return _write_export(root, step, flat, dtype, source, model=model_meta)
 
 
 def export_from_checkpoint(
-    ckpt_root: str, export_root: str, dtype: str = "bfloat16", ram=None
+    ckpt_root: str, export_root: str, dtype: str = "bfloat16", ram=None,
+    model_meta: Optional[Dict[str, Any]] = None,
 ) -> Optional[str]:
     """Assemble the params (only) of the newest committed sharded
     checkpoint into a servable export — the commit-leader path for
@@ -196,7 +203,8 @@ def export_from_checkpoint(
     finally:
         index.close()
     return _write_export(
-        export_root, step, flat, dtype, source=f"checkpoint:{ckpt_root}"
+        export_root, step, flat, dtype, source=f"checkpoint:{ckpt_root}",
+        model=model_meta,
     )
 
 

@@ -188,6 +188,10 @@ class Workload:
     # rendezvous so the compiled step matches the current elastic mesh.
     # When absent, the static loss_fn is used as-is.
     make_loss: Optional[Callable[[Any, Any], Callable]] = None
+    # JSON-safe architecture record (e.g. LlamaConfig.to_meta()) that
+    # rides export manifests so a serving consumer can rebuild the
+    # model (CLI: `edl generate`)
+    model_meta: Optional[Dict[str, Any]] = None
 
     def loss_for(self, plan, mesh) -> Callable:
         return self.make_loss(plan, mesh) if self.make_loss else self.loss_fn
@@ -255,6 +259,7 @@ def _llama_workload(cfg: WorkerConfig) -> Workload:
         # sp/pp are mesh-layout-dependent (ring attention shard_map /
         # GPipe schedule) — rebuild the loss per rendezvous
         make_loss=lambda plan, mesh: llama.make_loss_fn(mcfg, plan, mesh),
+        model_meta=mcfg.to_meta(),
     )
 
 
@@ -444,6 +449,7 @@ class ElasticWorker:
         self._last_local: Optional[Dict[str, np.ndarray]] = None
         self._resharded = 0
         self._local_rows = 0  # batch rows this process feeds per step
+        self._model_meta = None  # architecture record for exports
 
     # -- keys ----------------------------------------------------------------
     def _k(self, *parts: str) -> str:
@@ -730,6 +736,7 @@ class ElasticWorker:
                                 cfg.export_dir,
                                 dtype=cfg.export_dtype,
                                 ram=snap,  # skip re-reading own shards
+                                model_meta=self._model_meta,
                             )
                             if d:
                                 log.info(
@@ -831,6 +838,7 @@ class ElasticWorker:
         from edl_tpu.parallel.mesh import MeshPlan
 
         wl = WORKLOADS[cfg.model](cfg)
+        self._model_meta = wl.model_meta
         if cfg.data_dir:
             # real on-disk data: leased [start, end) ranges read shard
             # files instead of the workload's synthetic generator

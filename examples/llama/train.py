@@ -90,7 +90,8 @@ def main() -> int:
         from edl_tpu.runtime.export import export_params, load_export
 
         d = export_params(
-            args.export_dir, state.params, int(state.step), dtype="float32"
+            args.export_dir, state.params, int(state.step), dtype="float32",
+            model_meta=cfg.to_meta(),
         )
         print(f"export published: {d}")
         # the serving round trip: a consumer loads ONLY the export and

@@ -44,6 +44,65 @@ def test_generate_from_export(tmp_path):
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
+def test_cli_generate_from_export(tmp_path):
+    """`edl generate` — the one-command serving consumer: rebuilds the
+    config from the manifest's architecture record and decodes."""
+    import os
+    import subprocess
+    import sys
+
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    export_params(
+        str(tmp_path), params, step=1, dtype="float32",
+        model_meta=cfg.to_meta(),
+    )
+    out = subprocess.run(
+        [
+            sys.executable, "-m", "edl_tpu.cli", "generate", str(tmp_path),
+            "--prompt", "1,2,3,4", "--max-new", "5",
+        ],
+        capture_output=True,
+        text=True,
+        env={
+            **os.environ,
+            "PYTHONPATH": os.path.dirname(os.path.dirname(__file__)),
+        },
+    )
+    assert out.returncode == 0, out.stderr
+    toks = [int(t) for t in out.stdout.strip().split(",")]
+    want = llama.generate(
+        params, jnp.asarray([[1, 2, 3, 4]], jnp.int32), cfg, max_new=5
+    )
+    assert toks == [int(t) for t in np.asarray(want)[0]]
+    # an export without an architecture record is a clear error
+    export_params(str(tmp_path / "bare"), params, step=1, dtype="float32")
+    out = subprocess.run(
+        [
+            sys.executable, "-m", "edl_tpu.cli", "generate",
+            str(tmp_path / "bare"), "--prompt", "1",
+        ],
+        capture_output=True,
+        text=True,
+        env={
+            **os.environ,
+            "PYTHONPATH": os.path.dirname(os.path.dirname(__file__)),
+        },
+    )
+    assert out.returncode == 1 and "architecture record" in out.stderr
+
+
+def test_config_meta_roundtrip():
+    cfg = llama.LlamaConfig.tiny()
+    back = llama.LlamaConfig.from_meta(cfg.to_meta())
+    assert back.d_model == cfg.d_model and back.n_kv_heads == cfg.n_kv_heads
+    import json
+
+    json.dumps(cfg.to_meta())  # JSON-safe
+    with pytest.raises(ValueError, match="not a llama export"):
+        llama.LlamaConfig.from_meta({"family": "bert"})
+
+
 def test_generate_sampling_shape_and_determinism():
     cfg = llama.LlamaConfig.tiny()
     params = llama.init_params(jax.random.PRNGKey(0), cfg)
