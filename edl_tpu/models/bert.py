@@ -36,6 +36,14 @@ class BertConfig:
     norm_eps: float = 1e-12
     dtype: Any = jnp.bfloat16
 
+
+    def to_meta(self) -> dict:
+        """JSON-safe architecture record for export manifests
+        (the one shared rule: models/meta.py)."""
+        from edl_tpu.models.meta import dataclass_meta
+
+        return dataclass_meta(self, "bert")
+
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads

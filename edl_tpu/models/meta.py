@@ -1,0 +1,22 @@
+"""Shared architecture-record helper for export manifests.
+
+One implementation of the "config dataclass → JSON-safe dict" rule
+(bert/resnet/moe use it verbatim; llama hand-picks its serving-relevant
+fields because its record is also a load contract — from_meta)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax.numpy as jnp
+
+
+def dataclass_meta(cfg: Any, family: str) -> Dict[str, Any]:
+    out: Dict[str, Any] = {"family": family}
+    for f in dataclasses.fields(cfg):
+        v = getattr(cfg, f.name)
+        if f.name == "dtype":
+            v = jnp.dtype(v).name
+        out[f.name] = v
+    return out

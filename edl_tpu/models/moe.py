@@ -49,6 +49,14 @@ class MoEConfig:
     use_flash: bool = False
     remat: bool = False
 
+
+    def to_meta(self) -> dict:
+        """JSON-safe architecture record for export manifests
+        (the one shared rule: models/meta.py)."""
+        from edl_tpu.models.meta import dataclass_meta
+
+        return dataclass_meta(self, "moe")
+
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads

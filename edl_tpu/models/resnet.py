@@ -30,6 +30,14 @@ class ResNetConfig:
     groups: int = 32  # GroupNorm groups
     dtype: Any = jnp.bfloat16
 
+
+    def to_meta(self) -> dict:
+        """JSON-safe architecture record for export manifests
+        (the one shared rule: models/meta.py)."""
+        from edl_tpu.models.meta import dataclass_meta
+
+        return dataclass_meta(self, "resnet")
+
     @classmethod
     def resnet50(cls) -> "ResNetConfig":
         return cls()

@@ -281,6 +281,7 @@ def _bert_workload(cfg: WorkerConfig) -> Workload:
         bert.make_loss_fn(mcfg),
         batch_fn,
         pspecs=lambda plan: bert.param_pspecs(mcfg, plan),
+        model_meta=mcfg.to_meta(),
     )
 
 
@@ -302,6 +303,7 @@ def _resnet_workload(cfg: WorkerConfig) -> Workload:
         resnet.make_loss_fn(mcfg),
         batch_fn,
         pspecs=lambda plan: resnet.param_pspecs(mcfg, plan),
+        model_meta=mcfg.to_meta(),
     )
 
 
@@ -324,6 +326,7 @@ def _moe_workload(cfg: WorkerConfig) -> Workload:
         moe.make_loss_fn(mcfg),
         batch_fn,
         pspecs=lambda plan: moe.param_pspecs(mcfg, plan),
+        model_meta=mcfg.to_meta(),
     )
 
 
