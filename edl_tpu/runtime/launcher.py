@@ -62,6 +62,14 @@ class ProcessJobLauncher:
     export: bool = False  # publish servable params exports (export_dir)
     step_sleep_s: float = 0.0
     sync_every: int = 1  # delayed-sync DP: K local steps between averages
+    # virtual multi-slice topology: group every K consecutive workers
+    # into one TPU slice (0 = single-slice / undeclared). Worker wNNN
+    # gets EDL_SLICE = NNN // K, so a scale-up past one slice's hosts
+    # lands the new workers on the next slice — the BASELINE north-star
+    # shape (v5e-4 -> v5e-64 crosses slice boundaries). slice_map
+    # overrides per worker id for irregular layouts (tests).
+    workers_per_slice: int = 0
+    slice_map: Dict[str, int] = field(default_factory=dict)
     extra_env: Dict[str, str] = field(default_factory=dict)
 
     def __post_init__(self):
@@ -108,6 +116,33 @@ class ProcessJobLauncher:
 
     # -- pod lifecycle -------------------------------------------------------
 
+    def _slice_of(self, worker_id: str) -> int:
+        if worker_id in self.slice_map:
+            return self.slice_map[worker_id]
+        if self.workers_per_slice > 0:
+            return int(worker_id.lstrip("w")) // self.workers_per_slice
+        return -1
+
+    def slice_workers(self, slice_id: int) -> List[WorkerProc]:
+        """Live workers placed on one slice (fault injection: a slice
+        outage kills all of them at once)."""
+        return [
+            w for w in self.live_workers() if self._slice_of(w.worker_id) == slice_id
+        ]
+
+    def kill_slice(self, slice_id: int) -> List[str]:
+        """SIGKILL every live worker of a slice — the multi-slice fault
+        the north-star scenario must survive (a whole v5e slice
+        preempted at once). Tolerates workers exiting underfoot."""
+        victims = []
+        for w in self.slice_workers(slice_id):
+            try:
+                self.kill(w.worker_id)
+                victims.append(w.worker_id)
+            except KeyError:  # exited between listing and signal
+                pass
+        return victims
+
     def _env(self, worker_id: str) -> Dict[str, str]:
         env = dict(os.environ)
         env.update(
@@ -136,6 +171,7 @@ class ProcessJobLauncher:
                 "EDL_SEED": str(self.seed),
                 "EDL_STEP_SLEEP_S": str(self.step_sleep_s),
                 "EDL_SYNC_EVERY": str(self.sync_every),
+                "EDL_SLICE": str(self._slice_of(worker_id)),
                 "PYTHONPATH": os.pathsep.join(
                     [
                         os.path.dirname(

@@ -127,6 +127,15 @@ class WorkerConfig:
     # the job back to the last committed checkpoint (cadence:
     # ckpt_every) — graceful reshards/stops merge first and lose nothing.
     sync_every: int = 1
+    # TPU slice this host belongs to (multi-slice topology). -1 =
+    # unknown: the mesh build falls back to the hardware's own
+    # ``device.slice_index`` (real multislice TPU exposes it). When set
+    # (launcher/controller placement, or GKE's MEGASCALE_SLICE_ID), the
+    # worker publishes it in coordinator KV so EVERY peer can order the
+    # global device list slice-major at reshard — dp/pp cross slices
+    # over DCN, fsdp/sp/ep/tp stay inside one slice's ICI
+    # (parallel/mesh.py MeshPlan.build slices=...).
+    slice_id: int = -1
 
     @classmethod
     def from_env(cls, env: Optional[Dict[str, str]] = None) -> "WorkerConfig":
@@ -165,6 +174,12 @@ class WorkerConfig:
             sync_every=int(e.get("EDL_SYNC_EVERY", "1")),
             export_dir=e.get("EDL_EXPORT_DIR", ""),
             export_dtype=e.get("EDL_EXPORT_DTYPE", "bfloat16"),
+            # MEGASCALE_SLICE_ID is what GKE injects into multislice
+            # TPU pods — honoring it makes the kube path slice-aware
+            # with no manifest change
+            slice_id=int(
+                e.get("EDL_SLICE", e.get("MEGASCALE_SLICE_ID", "-1"))
+            ),
         )
 
 
@@ -857,6 +872,12 @@ class ElasticWorker:
 
         if self._leaving:  # SIGTERM during startup: never joined
             return 0
+        if cfg.slice_id >= 0:
+            # published BEFORE registration so any peer that sees us in
+            # membership can already read our slice id at rendezvous
+            self.client.kv_put(
+                self._k("slice", cfg.worker_id), str(cfg.slice_id)
+            )
         ctx = entrypoint.bootstrap(self.client)
         heartbeat_stop = self._start_heartbeat(ctx.incarnation)
         try:
@@ -940,7 +961,23 @@ class ElasticWorker:
             signal.signal(signal.SIGTERM, self._on_sigterm)
             devs = jax.devices()
             plan = MeshPlan.parse(cfg.mesh, len(devs))
-            mesh = plan.build(devs)
+            slices = self._device_slices(cl, members, devs)
+            mesh = plan.build(devs, slices=slices)
+            if rank == 0:
+                # observability: the CURRENT epoch's mesh device order
+                # by slice (slice-major by construction when multi —
+                # inner axes intact, or build would have raised).
+                # Re-published every epoch so a reshard back to one
+                # slice doesn't leave a defunct layout advertised.
+                # Consumed by tests/monitor.
+                if slices is not None:
+                    sl_of = {id(d): s for d, s in zip(devs, slices)}
+                    val = ",".join(
+                        str(sl_of[id(d)]) for d in mesh.devices.flatten()
+                    )
+                else:
+                    val = ""  # slice-blind epoch
+                cl.kv_put(self._k("mesh_slices"), val)
             rows = cfg.per_device_batch * plan.batch_shards()
             if rows % world:
                 raise ValueError(
@@ -983,6 +1020,31 @@ class ElasticWorker:
             _clear_backends()
             if self._leaving:
                 return self._depart(code=0)
+
+    def _device_slices(self, cl, members, devs):
+        """Per-device slice ids for this epoch's global device list,
+        from each member's published slice KV (runtime/worker_main.py
+        run()). Returns None — mesh build falls back to the hardware's
+        own ``device.slice_index`` — when this worker has no declared
+        slice or any peer's is missing: a half-declared topology must
+        not silently build a wrong slice-major order."""
+        if self.cfg.slice_id < 0:
+            return None
+        by_rank = {}
+        for m in members:
+            v = cl.kv_get(self._k("slice", m.name))
+            if v is None or int(v) < 0:
+                log.warn(
+                    "member without slice id; building slice-blind mesh",
+                    member=m.name,
+                )
+                return None
+            # member rank == jax.distributed process id (rendezvous
+            # passes me.rank to _initialize_distributed)
+            by_rank[m.rank] = int(v)
+        if any(d.process_index not in by_rank for d in devs):
+            return None
+        return [by_rank[d.process_index] for d in devs]
 
     def _ensure_queue(self, cl) -> None:
         cfg = self.cfg

@@ -51,6 +51,9 @@ def test_randomized_kill_scale_schedule(tmp_path, seed):
         step_sleep_s=0.05,
         member_ttl_s=2.0,
         lease_timeout_s=3.0,
+        # virtual 2-worker slices: the slice-kill arm below can take an
+        # entire slice down at once (multi-slice fault coverage)
+        workers_per_slice=2,
         work_dir=str(tmp_path),
     ) as launcher:
         launcher.start(2)
@@ -119,10 +122,26 @@ def test_randomized_kill_scale_schedule(tmp_path, seed):
                 launcher.kill_coordinator()
                 time.sleep(rng.random() * 0.5)
                 launcher.restart_coordinator()
-            else:
+            elif roll < 0.9:
                 n = rng.randint(1, 4)
                 events.append(("scale", n))
                 drained.update(launcher.scale_to(n))
+            else:
+                # whole-slice outage: SIGKILL every live worker on one
+                # slice at once (a preempted v5e slice), sparing the
+                # senior worker's slice so completion stays well-defined
+                senior_slice = launcher._slice_of(live[0].worker_id)
+                other = sorted(
+                    {launcher._slice_of(w.worker_id) for w in live}
+                    - {senior_slice}
+                )
+                if other:
+                    victims = launcher.kill_slice(other[-1])
+                    events.append(("slice-kill", other[-1], tuple(victims)))
+                else:
+                    n = rng.randint(1, 4)
+                    events.append(("scale", n))
+                    drained.update(launcher.scale_to(n))
         rcs = launcher.wait(timeout_s=420)
 
         killed = set()
@@ -131,6 +150,8 @@ def test_randomized_kill_scale_schedule(tmp_path, seed):
                 killed.add(ev[1])
             elif ev[0] == "scale+kill":
                 killed.add(ev[2])
+            elif ev[0] == "slice-kill":
+                killed.update(ev[2])
         sigterm = -signal.SIGTERM
         for w, rc in rcs.items():
             if w in killed:

@@ -530,3 +530,76 @@ def test_background_commits_under_rescale(tmp_path):
         assert manifest["step"] == launcher.progress()
         assert int(launcher.kv("ckpt_step")) == launcher.progress()
         assert float(launcher.kv("loss_last")) < float(launcher.kv("loss_first"))
+
+
+def test_llama_fsdp_elastic_scale_across_slices(tmp_path):
+    """Slice-aware elastic process runtime (VERDICT r3 #1 — the
+    BASELINE north-star shape, v5e-4 -> v5e-64 crossing slice
+    boundaries): 2 workers start on virtual slice 0, the job scales to
+    4 workers spanning slices {0,1} THROUGH the elastic runtime. The
+    post-scale mesh must come up slice-major — dp varies across slices
+    (DCN-legal), the pinned fsdp blocks stay inside one slice's ICI —
+    and the job completes with exact task accounting."""
+    with ProcessJobLauncher(
+        job="mpslice",
+        model="llama",
+        mesh="fsdp=2,dp",
+        min_workers=2,
+        max_workers=4,
+        n_samples=768,
+        passes=1,
+        per_device_batch=8,
+        local_devices=2,
+        seq_len=32,
+        ckpt_every=4,
+        step_sleep_s=0.25,
+        workers_per_slice=2,
+        work_dir=str(tmp_path),
+        extra_env={"EDL_VOCAB": "512"},
+    ) as launcher:
+        launcher.start(2)
+        launcher.wait_progress(2, timeout_s=240)
+        launcher.scale_to(4)  # w002/w003 land on slice 1
+        rcs = launcher.wait(timeout_s=480)
+        _assert_succeeded(launcher, rcs)
+        assert len(rcs) == 4
+        assert int(launcher.kv("reshards") or "0") >= 1
+        # the multi-slice epoch's mesh device order: slice-major, with
+        # each fsdp block (2 devices) inside one slice — a straddling
+        # layout would have raised in MeshPlan.build and failed the job
+        order = (launcher.kv("mesh_slices") or "").split(",")
+        assert order == ["0"] * 4 + ["1"] * 4, order
+        # exact accounting: queue chunk fixed at init (2 workers, 4
+        # devices, batch_shards=4 -> 32 rows/step over world 2 = 16)
+        stats = launcher.client.queue_stats()
+        assert stats["done"] == 768 // 16, stats
+        assert stats["dead"] == 0 and stats["todo"] == 0
+        assert float(launcher.kv("loss_last")) < float(launcher.kv("loss_first"))
+
+
+def test_slice_major_reorder_interleaved(tmp_path):
+    """A membership whose process order interleaves slices (w000->0,
+    w001->1, w002->0, w003->1) must still build a slice-major mesh:
+    MeshPlan.build reorders the global device list so inner axes never
+    straddle a slice. This is the layout-correctness half of the
+    multi-slice contract, independent of elasticity."""
+    with ProcessJobLauncher(
+        job="mpilv",
+        model="linreg",
+        mesh="fsdp=2,dp",
+        min_workers=4,
+        max_workers=4,
+        n_samples=4096,
+        passes=1,
+        per_device_batch=8,
+        local_devices=2,
+        slice_map={"w000": 0, "w001": 1, "w002": 0, "w003": 1},
+        work_dir=str(tmp_path),
+    ) as launcher:
+        launcher.start(4)
+        rcs = launcher.wait(timeout_s=240)
+        _assert_succeeded(launcher, rcs)
+        # device order p0,p1,p2,p3 -> slice-major p0,p2 | p1,p3
+        order = (launcher.kv("mesh_slices") or "").split(",")
+        assert order == ["0"] * 4 + ["1"] * 4, order
+        assert float(launcher.kv("loss_last")) < float(launcher.kv("loss_first"))
