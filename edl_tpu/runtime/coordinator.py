@@ -165,6 +165,12 @@ class NativeCoordinator:
         lib.edl_queue_release_worker.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.edl_queue_done.argtypes = [ctypes.c_void_p]
         lib.edl_queue_stats.argtypes = [ctypes.c_void_p, ctypes.c_longlong * 5]
+        lib.edl_wal_compact.argtypes = [ctypes.c_void_p]
+        lib.edl_wal_set_compact_bytes.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_longlong,
+        ]
+        lib.edl_wal_stats.argtypes = [ctypes.c_void_p, ctypes.c_longlong * 2]
         self._lib = lib
         # wal_path makes the coordinator durable: mutations append to a
         # write-ahead log; a new instance on the same path replays it
@@ -269,6 +275,19 @@ class NativeCoordinator:
             "dead": out[3],
             "epoch": out[4],
         }
+
+    # WAL compaction (snapshot+truncate: replay cost O(state), not
+    # O(history) — the compacted-etcd-durability analog)
+    def wal_compact(self) -> None:
+        self._lib.edl_wal_compact(self._h)
+
+    def set_wal_compact_bytes(self, n: int) -> None:
+        self._lib.edl_wal_set_compact_bytes(self._h, n)
+
+    def wal_stats(self) -> Dict[str, int]:
+        out = (ctypes.c_longlong * 2)()
+        self._lib.edl_wal_stats(self._h, out)
+        return {"appended_bytes": out[0], "compactions": out[1]}
 
 
 class CoordinatorClient:
@@ -417,6 +436,13 @@ class CoordinatorClient:
         keys = ("todo", "leased", "done", "dead", "epoch")
         return dict(zip(keys, map(int, parts)))
 
+    def wal_compact(self) -> None:
+        self._call("COMPACT")
+
+    def wal_stats(self) -> Dict[str, int]:
+        parts = self._call("WALSTATS").split()[1:]
+        return {"appended_bytes": int(parts[0]), "compactions": int(parts[1])}
+
 
 class CoordinatorServer:
     """Spawn/own an edl-coordinator process (per-job coordinator pod
@@ -428,7 +454,11 @@ class CoordinatorServer:
     it)."""
 
     def __init__(
-        self, port: int = 0, member_ttl_s: float = 10.0, wal_path: str = ""
+        self,
+        port: int = 0,
+        member_ttl_s: float = 10.0,
+        wal_path: str = "",
+        wal_compact_bytes: int = 0,  # 0 = server default (1 MiB)
     ):
         if not ensure_native_built():
             raise RuntimeError("native coordinator unavailable")
@@ -440,6 +470,7 @@ class CoordinatorServer:
         self.port = port
         self.member_ttl_s = member_ttl_s
         self.wal_path = wal_path
+        self.wal_compact_bytes = wal_compact_bytes
         self._spawn()
 
     def _spawn(self) -> None:
@@ -450,6 +481,8 @@ class CoordinatorServer:
         ]
         if self.wal_path:
             cmd += ["--wal", self.wal_path]
+        if self.wal_compact_bytes > 0:
+            cmd += ["--wal-compact-bytes", str(self.wal_compact_bytes)]
         self._proc = subprocess.Popen(
             cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT
         )

@@ -81,6 +81,13 @@ def main(argv=None) -> int:
         while True:
             try:
                 if cl.kv_get(done_key):
+                    # we are the only reader: retire the mark ourselves
+                    # so the coordinator KV stays O(live state) without
+                    # the workers having to guess when our poll ran
+                    try:
+                        cl.kv_del(done_key)
+                    except Exception:
+                        pass
                     print("dist_service dismissed", flush=True)
                     break
                 if cl.epoch() != a.epoch:

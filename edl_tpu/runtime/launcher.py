@@ -70,6 +70,10 @@ class ProcessJobLauncher:
     # overrides per worker id for irregular layouts (tests).
     workers_per_slice: int = 0
     slice_map: Dict[str, int] = field(default_factory=dict)
+    # coordinator WAL auto-compaction threshold (bytes appended since
+    # the last snapshot; 0 = server default 1 MiB). The WAL stays
+    # O(state) regardless of job length.
+    wal_compact_bytes: int = 0
     extra_env: Dict[str, str] = field(default_factory=dict)
 
     def __post_init__(self):
@@ -84,7 +88,9 @@ class ProcessJobLauncher:
         if os.path.exists(wal_path):
             os.remove(wal_path)
         self.server = CoordinatorServer(
-            member_ttl_s=self.member_ttl_s, wal_path=wal_path
+            member_ttl_s=self.member_ttl_s,
+            wal_path=wal_path,
+            wal_compact_bytes=self.wal_compact_bytes,
         )
         self.client: CoordinatorClient = self.server.client()
         self.workers: List[WorkerProc] = []

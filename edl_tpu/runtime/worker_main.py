@@ -468,6 +468,15 @@ class ElasticWorker:
         self._resharded = 0
         self._local_rows = 0  # batch rows this process feeds per step
         self._model_meta = None  # architecture record for exports
+        # epoch-scoped KV (go/dist/disc keys) retired by past epochs,
+        # GC'd one epoch later — keeps the coordinator KV (and its WAL
+        # snapshots) O(live state), not O(job epochs). dist_done marks
+        # go through _gc_later (an extra epoch of delay): the detached
+        # service host polls them every 0.5 s and normally deletes its
+        # own, so the worker only sweeps up after a crashed host — and
+        # must not win a race against a live host's dismissal poll.
+        self._gc_keys: list = []
+        self._gc_later: list = []
 
     # -- keys ----------------------------------------------------------------
     def _k(self, *parts: str) -> str:
@@ -950,6 +959,9 @@ class ElasticWorker:
                 if cl.kv_get(self._k("dist", str(epoch))) == addr:
                     cl.kv_del(self._k("dist", str(epoch)))
                     cl.kv_put(self._dist_done_key(epoch, addr), "1")
+                    # a live host deletes its own mark; sweep up after a
+                    # dead one so failed inits don't leak KV forever
+                    self._gc_later.append(self._dist_done_key(epoch, addr))
                 init_failures += 1
                 if init_failures >= 5:
                     raise RuntimeError(
@@ -1002,6 +1014,18 @@ class ElasticWorker:
                 )
                 state = stepper.localize(state)
 
+            # GC the epoch-scoped keys recorded at our own past
+            # teardowns. Safe HERE (after _initialize_distributed):
+            # every member has connected to this epoch's service, which
+            # it only does after finishing the previous epoch's
+            # teardown — nobody still reads those keys. EVERY worker
+            # drains its own list (deletes are idempotent across
+            # peers), so the keys go away even when rank 0 is a
+            # freshly restarted process with no history.
+            for k in self._gc_keys:
+                cl.kv_del(k)
+            self._gc_keys = self._gc_later
+            self._gc_later = []
             if rank == 0:
                 self._ensure_queue(cl)
             outcome = self._train_epoch(
@@ -1273,6 +1297,14 @@ class ElasticWorker:
         service as fatal)."""
         me = self.cfg.worker_id
         disc = lambda name: self._k("disc", str(epoch), name)  # noqa: E731
+        # retire this epoch's coordination keys at the NEXT rendezvous
+        # (they must survive until every peer has left the epoch; the
+        # dist_done mark must outlive the service host's dismissal poll)
+        self._gc_keys += (
+            [self._k("go", str(epoch)), self._k("dist", str(epoch))]
+            + [disc(m.name) for m in members]
+        )
+        self._gc_later.append(self._dist_done_key(epoch, addr))
         cl.expire()
         alive = {m.name for m in cl.members()}
         leader = min(

@@ -106,4 +106,17 @@ void edl_queue_stats(void* h, long long out[5]) {
   for (int i = 0; i < 5; ++i) out[i] = s[i];
 }
 
+// WAL compaction: force a snapshot+truncate / tune the auto threshold /
+// read [appended bytes since last compaction, compaction count].
+void edl_wal_compact(void* h) { static_cast<Coordinator*>(h)->Compact(); }
+void edl_wal_set_compact_bytes(void* h, long long bytes) {
+  static_cast<Coordinator*>(h)->SetWalCompactBytes(bytes);
+}
+void edl_wal_stats(void* h, long long out[2]) {
+  int64_t s[2];
+  static_cast<Coordinator*>(h)->WalStats(s);
+  out[0] = s[0];
+  out[1] = s[1];
+}
+
 }  // extern "C"

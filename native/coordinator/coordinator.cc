@@ -1,5 +1,7 @@
 #include "coordinator.h"
 
+#include <unistd.h>
+
 #include <algorithm>
 #include <cerrno>
 #include <chrono>
@@ -18,8 +20,15 @@ std::string EscapeWal(const std::string& s, bool escape_space) {
   std::string out;
   out.reserve(s.size());
   for (char c : s) {
+    // every char istream>> treats as a delimiter must be escaped in
+    // token position (\t \r \v \f as well as space), and \n always —
+    // otherwise a name containing it is silently split at replay
     if (c == '\\') out += "\\\\";
     else if (c == '\n') out += "\\n";
+    else if (c == '\t') out += "\\t";
+    else if (c == '\r') out += "\\r";
+    else if (c == '\v') out += "\\v";
+    else if (c == '\f') out += "\\f";
     else if (c == ' ' && escape_space) out += "\\_";
     else out += c;
   }
@@ -32,7 +41,13 @@ std::string UnescapeWal(const std::string& s) {
   for (size_t i = 0; i < s.size(); ++i) {
     if (s[i] == '\\' && i + 1 < s.size()) {
       char n = s[++i];
-      out += n == 'n' ? '\n' : n == '_' ? ' ' : n;
+      out += n == 'n'   ? '\n'
+             : n == 't' ? '\t'
+             : n == 'r' ? '\r'
+             : n == 'v' ? '\v'
+             : n == 'f' ? '\f'
+             : n == '_' ? ' '
+                        : n;
     } else {
       out += s[i];
     }
@@ -69,7 +84,7 @@ double Coordinator::Now() {
 //   W <worker>                            release all of worker's leases
 
 Coordinator::Coordinator(double member_ttl_s, const std::string& wal_path)
-    : member_ttl_s_(member_ttl_s) {
+    : member_ttl_s_(member_ttl_s), wal_path_(wal_path) {
   if (wal_path.empty()) return;
   std::lock_guard<std::mutex> lock(mu_);
   WalReplayLocked(wal_path);
@@ -95,13 +110,142 @@ Coordinator::~Coordinator() {
 }
 
 void Coordinator::WalAppendLocked(const std::string& line) {
-  if (!wal_ || replaying_) return;
+  if (replaying_) return;
+  if (!wal_ && !wal_path_.empty()) {
+    // transient open failure earlier (reopen after compaction, EMFILE,
+    // ...): retry rather than running silently non-durable forever
+    wal_ = std::fopen(wal_path_.c_str(), "a");
+  }
+  if (!wal_) return;
   std::fwrite(line.data(), 1, line.size(), wal_);
   std::fputc('\n', wal_);
   // flush to the OS on every mutation: survives SIGKILL of this
   // process (page cache persists); a machine crash can lose the tail,
   // which costs at most re-running un-acked tasks (at-least-once)
   std::fflush(wal_);
+  wal_appended_ += static_cast<int64_t>(line.size()) + 1;
+}
+
+// ------------------------------------------------------- WAL compaction
+//
+// The etcd analog of compacted durability (reference:
+// pkg/jobparser.go:167-184 relies on etcd, which compacts): without
+// this the log is O(mutation history) and a multi-day job replays its
+// whole life on every coordinator restart. The snapshot is itself a
+// valid WAL (S-ops below), written to <wal>.tmp and atomically renamed
+// over the log, so recovery stays "replay one file" and a crash at any
+// point leaves either the old or the new log intact.
+
+void Coordinator::MaybeCompactLocked() {
+  // wal_attempt_mark_ backs off retries after a FAILED compaction: the
+  // next attempt waits for another threshold's worth of appends instead
+  // of re-trying (and re-printing) on every mutation
+  if (wal_ && !replaying_ &&
+      wal_appended_ - wal_attempt_mark_ > wal_compact_bytes_) {
+    CompactLocked();
+  }
+}
+
+void Coordinator::CompactLocked() {
+  if (!wal_ || wal_path_.empty()) return;
+  wal_attempt_mark_ = wal_appended_;
+  const std::string tmp = wal_path_ + ".tmp";
+  std::FILE* f = std::fopen(tmp.c_str(), "w");
+  if (!f) {
+    std::fprintf(stderr, "edl-coordinator: cannot open %s: %s\n",
+                 tmp.c_str(), std::strerror(errno));
+    return;
+  }
+  // a partial snapshot must NEVER replace a complete log: check every
+  // write (ENOSPC/EIO truncate silently otherwise) and the fsync
+  // before the rename is allowed to land
+  bool ok = WriteSnapshotLocked(f);
+  ok = ok && std::fflush(f) == 0 && fsync(fileno(f)) == 0 && !std::ferror(f);
+  if (std::fclose(f) != 0) ok = false;
+  if (!ok) {
+    std::fprintf(stderr, "edl-coordinator: snapshot write to %s failed: %s\n",
+                 tmp.c_str(), std::strerror(errno));
+    std::remove(tmp.c_str());
+    return;  // keep appending to the intact old log
+  }
+  std::fclose(wal_);
+  wal_ = nullptr;
+  if (std::rename(tmp.c_str(), wal_path_.c_str()) != 0) {
+    std::fprintf(stderr, "edl-coordinator: rename %s failed: %s\n",
+                 tmp.c_str(), std::strerror(errno));
+    std::remove(tmp.c_str());
+    // reopen the (uncompacted) old log and keep appending; counters
+    // unchanged so wal_stats stays honest
+    wal_ = std::fopen(wal_path_.c_str(), "a");
+    return;
+  }
+  // success: append to the fresh snapshot-log (WalAppendLocked retries
+  // the reopen on later mutations if this one transiently fails)
+  wal_ = std::fopen(wal_path_.c_str(), "a");
+  if (!wal_) {
+    std::fprintf(stderr, "edl-coordinator: cannot reopen WAL %s: %s\n",
+                 wal_path_.c_str(), std::strerror(errno));
+  }
+  wal_appended_ = 0;
+  wal_attempt_mark_ = 0;
+  ++wal_compactions_;
+}
+
+bool Coordinator::WriteSnapshotLocked(std::FILE* f) {
+  bool ok = true;
+  auto line = [f, &ok](const std::string& s) {
+    ok = ok && std::fwrite(s.data(), 1, s.size(), f) == s.size();
+    ok = ok && std::fputc('\n', f) != EOF;
+  };
+  for (const auto& [k, v] : kv_) {
+    line("P " + EscapeWal(k, true) + " " + EscapeWal(v, false));
+  }
+  for (const auto& [name, m] : members_) {
+    line("R " + EscapeWal(name, true) + " " + std::to_string(m.incarnation));
+  }
+  // replaying the R lines bumps epoch_ per member; SE restores the
+  // exact live value so epoch comparisons survive a restart
+  line("SE " + std::to_string(epoch_));
+  for (const auto& [name, parties] : barriers_) {
+    for (const auto& [w, _] : parties) {
+      line("B " + EscapeWal(name, true) + " " + EscapeWal(w, true));
+    }
+  }
+  if (n_samples_ > 0) {
+    std::ostringstream os;
+    os << "SQ " << n_samples_ << " " << chunk_ << " " << passes_ << " "
+       << lease_timeout_s_ << " " << max_failures_ << " " << q_epoch_ << " "
+       << next_task_id_ << " " << done_count_ << " " << (queue_ready_ ? 1 : 0);
+    line(os.str());
+    auto task_fields = [](const Task& t) {
+      std::ostringstream ts;
+      ts << t.id << " " << t.start << " " << t.end << " " << t.epoch << " "
+         << t.failures;
+      return ts.str();
+    };
+    for (const auto& t : todo_) line("ST " + task_fields(t));
+    for (const auto& [id, rec] : leases_) {
+      line("SL " + task_fields(rec.task) + " " + EscapeWal(rec.worker, true));
+    }
+    for (const auto& t : dead_) line("SD " + task_fields(t));
+  }
+  return ok;
+}
+
+void Coordinator::Compact() {
+  std::lock_guard<std::mutex> lock(mu_);
+  CompactLocked();
+}
+
+void Coordinator::SetWalCompactBytes(int64_t bytes) {
+  std::lock_guard<std::mutex> lock(mu_);
+  wal_compact_bytes_ = bytes;
+}
+
+void Coordinator::WalStats(int64_t out[2]) {
+  std::lock_guard<std::mutex> lock(mu_);
+  out[0] = wal_appended_;
+  out[1] = wal_compactions_;
 }
 
 void Coordinator::WalReplayLocked(const std::string& path) {
@@ -138,20 +282,20 @@ void Coordinator::WalApplyLocked(const std::string& line, double now) {
     std::string w;
     int64_t inc = 0;
     in >> w >> inc;
-    RegisterLocked(w, inc);  // fresh TTL at recovery time
+    RegisterLocked(UnescapeWal(w), inc);  // fresh TTL at recovery time
   } else if (op == "L") {
     std::string w;
     in >> w;
-    if (members_.erase(w) > 0) ++epoch_;
+    if (members_.erase(UnescapeWal(w)) > 0) ++epoch_;
   } else if (op == "X") {
     std::string w;
     bool any = false;
-    while (in >> w) any |= members_.erase(w) > 0;
+    while (in >> w) any |= members_.erase(UnescapeWal(w)) > 0;
     if (any) ++epoch_;
   } else if (op == "B") {
     std::string name, w;
     in >> name >> w;
-    barriers_[name][w] = true;
+    barriers_[UnescapeWal(name)][UnescapeWal(w)] = true;
   } else if (op == "Q") {
     int64_t n = 0, chunk = 0;
     int32_t passes = 1, maxfail = 3;
@@ -174,7 +318,7 @@ void Coordinator::WalApplyLocked(const std::string& line, double now) {
     t.end = end;
     t.epoch = ep;
     t.failures = fails;
-    LeaseAsLocked(t, w, now);
+    LeaseAsLocked(t, UnescapeWal(w), now);
   } else if (op == "O") {
     int64_t id = 0;
     in >> id;
@@ -190,13 +334,47 @@ void Coordinator::WalApplyLocked(const std::string& line, double now) {
   } else if (op == "W") {
     std::string w;
     in >> w;
+    const std::string worker = UnescapeWal(w);
     for (auto it = leases_.begin(); it != leases_.end();) {
-      if (it->second.worker == w) {
+      if (it->second.worker == worker) {
         RequeueLocked(it->second.task);
         it = leases_.erase(it);
       } else {
         ++it;
       }
+    }
+  } else if (op == "SE") {
+    // snapshot: exact epoch (the snapshot's R lines each bumped it)
+    in >> epoch_;
+  } else if (op == "SQ") {
+    // snapshot: queue config + counters, NO epoch fill (ST/SL/SD lines
+    // carry the exact task population)
+    int ready = 0;
+    in >> n_samples_ >> chunk_ >> passes_ >> lease_timeout_s_ >>
+        max_failures_ >> q_epoch_ >> next_task_id_ >> done_count_ >> ready;
+    queue_ready_ = ready != 0;
+    todo_.clear();
+    leases_.clear();
+    dead_.clear();
+  } else if (op == "ST" || op == "SL" || op == "SD") {
+    Task t;
+    long long id = 0, start = 0, end = 0;
+    int32_t ep = 0, fails = 0;
+    in >> id >> start >> end >> ep >> fails;
+    t.id = id;
+    t.start = start;
+    t.end = end;
+    t.epoch = ep;
+    t.failures = fails;
+    if (op == "ST") {
+      todo_.push_back(t);
+    } else if (op == "SD") {
+      dead_.push_back(t);
+    } else {
+      std::string w;
+      in >> w;
+      // fresh lease clock at recovery (same policy as T replay)
+      leases_[t.id] = LeaseRec{t, UnescapeWal(w), now + lease_timeout_s_};
     }
   }
   // unknown ops are skipped (forward compatibility)
@@ -206,6 +384,7 @@ void Coordinator::WalApplyLocked(const std::string& line, double now) {
 
 void Coordinator::KvPut(const std::string& key, const std::string& value) {
   std::lock_guard<std::mutex> lock(mu_);
+  MaybeCompactLocked();
   kv_[key] = value;
   WalAppendLocked("P " + EscapeWal(key, true) + " " + EscapeWal(value, false));
 }
@@ -220,6 +399,7 @@ bool Coordinator::KvGet(const std::string& key, std::string* value) const {
 
 void Coordinator::KvDel(const std::string& key) {
   std::lock_guard<std::mutex> lock(mu_);
+  MaybeCompactLocked();
   kv_.erase(key);
   WalAppendLocked("D " + EscapeWal(key, true));
 }
@@ -241,12 +421,14 @@ int64_t Coordinator::RegisterLocked(const std::string& worker, int64_t inc) {
 
 int64_t Coordinator::Register(const std::string& worker, int64_t incarnation) {
   std::lock_guard<std::mutex> lock(mu_);
+  MaybeCompactLocked();
   int64_t before = epoch_;
   bool absent = members_.find(worker) == members_.end();
   int64_t e = RegisterLocked(worker, incarnation);
   // log only membership-changing registrations (not pure TTL refresh)
   if (e != before || absent) {
-    WalAppendLocked("R " + worker + " " + std::to_string(incarnation));
+    WalAppendLocked("R " + EscapeWal(worker, true) + " " +
+                    std::to_string(incarnation));
   }
   return e;
 }
@@ -261,20 +443,22 @@ bool Coordinator::Heartbeat(const std::string& worker) {
 
 int64_t Coordinator::Leave(const std::string& worker) {
   std::lock_guard<std::mutex> lock(mu_);
+  MaybeCompactLocked();
   if (members_.erase(worker) > 0) {
     ++epoch_;
-    WalAppendLocked("L " + worker);
+    WalAppendLocked("L " + EscapeWal(worker, true));
   }
   return epoch_;
 }
 
 int64_t Coordinator::ExpireMembers() {
   std::lock_guard<std::mutex> lock(mu_);
+  MaybeCompactLocked();
   double now = Now();
   std::string expired;
   for (auto it = members_.begin(); it != members_.end();) {
     if (it->second.expires <= now) {
-      expired += (expired.empty() ? "" : " ") + it->first;
+      expired += (expired.empty() ? "" : " ") + EscapeWal(it->first, true);
       it = members_.erase(it);
     } else {
       ++it;
@@ -309,9 +493,11 @@ std::vector<MemberInfo> Coordinator::Members() const {
 int32_t Coordinator::BarrierArrive(const std::string& name,
                                    const std::string& worker) {
   std::lock_guard<std::mutex> lock(mu_);
+  MaybeCompactLocked();
   auto& parties = barriers_[name];
   if (parties.find(worker) == parties.end()) {
-    WalAppendLocked("B " + name + " " + worker);
+    WalAppendLocked("B " + EscapeWal(name, true) + " " +
+                    EscapeWal(worker, true));
   }
   parties[worker] = true;
   return static_cast<int32_t>(parties.size());
@@ -418,6 +604,7 @@ void Coordinator::LeaseAsLocked(const Task& t, const std::string& worker,
 
 bool Coordinator::Lease(const std::string& worker, Task* out) {
   std::lock_guard<std::mutex> lock(mu_);
+  MaybeCompactLocked();
   if (!queue_ready_) return false;
   ReapLeasesLocked(Now());
   if (todo_.empty() && leases_.empty()) AdvanceEpochLocked();
@@ -427,7 +614,7 @@ bool Coordinator::Lease(const std::string& worker, Task* out) {
   leases_[t.id] = LeaseRec{t, worker, Now() + lease_timeout_s_};
   std::ostringstream os;
   os << "T " << t.id << " " << t.start << " " << t.end << " " << t.epoch
-     << " " << t.failures << " " << worker;
+     << " " << t.failures << " " << EscapeWal(worker, true);
   WalAppendLocked(os.str());
   *out = t;
   return true;
@@ -443,6 +630,7 @@ bool Coordinator::AckLocked(int64_t task_id) {
 
 bool Coordinator::Ack(int64_t task_id) {
   std::lock_guard<std::mutex> lock(mu_);
+  MaybeCompactLocked();
   if (!AckLocked(task_id)) return false;
   WalAppendLocked("A " + std::to_string(task_id));
   if (todo_.empty() && leases_.empty()) AdvanceEpochLocked();
@@ -459,6 +647,7 @@ bool Coordinator::NackLocked(int64_t task_id) {
 
 bool Coordinator::Nack(int64_t task_id) {
   std::lock_guard<std::mutex> lock(mu_);
+  MaybeCompactLocked();
   if (!NackLocked(task_id)) return false;
   WalAppendLocked("N " + std::to_string(task_id));
   return true;
@@ -466,6 +655,7 @@ bool Coordinator::Nack(int64_t task_id) {
 
 int32_t Coordinator::ReleaseWorker(const std::string& worker) {
   std::lock_guard<std::mutex> lock(mu_);
+  MaybeCompactLocked();
   int32_t n = 0;
   for (auto it = leases_.begin(); it != leases_.end();) {
     if (it->second.worker == worker) {
@@ -476,7 +666,7 @@ int32_t Coordinator::ReleaseWorker(const std::string& worker) {
       ++it;
     }
   }
-  if (n > 0) WalAppendLocked("W " + worker);
+  if (n > 0) WalAppendLocked("W " + EscapeWal(worker, true));
   return n;
 }
 

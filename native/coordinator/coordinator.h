@@ -95,6 +95,16 @@ class Coordinator {
   // todo, leased, done, dead, epoch
   void QueueStats(int64_t out[5]);
 
+  // -- WAL compaction ---------------------------------------------------
+  // Snapshot the full state into a fresh log and truncate: replay cost
+  // becomes O(state), not O(history). Auto-triggered whenever the
+  // bytes appended since the last compaction exceed the threshold
+  // (default 1 MiB); Compact() forces one (checkpoint-commit cadence).
+  void Compact();
+  void SetWalCompactBytes(int64_t bytes);
+  // out: [appended bytes since last compaction, compaction count]
+  void WalStats(int64_t out[2]);
+
  private:
   void FillEpochLocked(int32_t epoch);
   void RequeueLocked(Task t);
@@ -108,6 +118,11 @@ class Coordinator {
   void WalAppendLocked(const std::string& line);
   void WalReplayLocked(const std::string& path);
   void WalApplyLocked(const std::string& line, double now);
+  // Compaction: called at public-mutator ENTRY (state is consistent
+  // there; an append mid-mutation may precede its state change).
+  void MaybeCompactLocked();
+  void CompactLocked();
+  bool WriteSnapshotLocked(std::FILE* f);  // false on any write error
 
   // shared locked mutators (public API + WAL replay)
   int64_t RegisterLocked(const std::string& worker, int64_t inc);
@@ -122,6 +137,11 @@ class Coordinator {
   double member_ttl_s_;
   std::FILE* wal_ = nullptr;
   bool replaying_ = false;
+  std::string wal_path_;
+  int64_t wal_appended_ = 0;  // bytes since last compaction (or open)
+  int64_t wal_attempt_mark_ = 0;  // wal_appended_ at the last FAILED try
+  int64_t wal_compact_bytes_ = 1 << 20;
+  int64_t wal_compactions_ = 0;
 
   std::map<std::string, std::string> kv_;
 

@@ -140,6 +140,15 @@ std::string Handle(const std::string& line) {
     for (int i = 0; i < 5; ++i) out += " " + std::to_string(s[i]);
     return out;
   }
+  if (cmd == "COMPACT") {  // snapshot+truncate the WAL now
+    g_coord->Compact();
+    return "OK";
+  }
+  if (cmd == "WALSTATS") {
+    int64_t s[2];
+    g_coord->WalStats(s);
+    return "WAL " + std::to_string(s[0]) + " " + std::to_string(s[1]);
+  }
   return "ERR unknown command";
 }
 
@@ -171,12 +180,17 @@ int main(int argc, char** argv) {
   int port = 7164;  // the reference's default job port (pkg/jobparser.go:50)
   double ttl = 10.0;
   const char* wal = "";
+  long long compact_bytes = 0;  // 0 = library default (1 MiB)
   for (int i = 1; i < argc - 1; ++i) {
     if (!strcmp(argv[i], "--port")) port = atoi(argv[i + 1]);
     if (!strcmp(argv[i], "--member-ttl")) ttl = atof(argv[i + 1]);
     // durability: replay + append the write-ahead log (etcd analog) —
     // a restarted coordinator resumes with exact KV/queue accounting
     if (!strcmp(argv[i], "--wal")) wal = argv[i + 1];
+    // WAL auto-compaction threshold: snapshot+truncate once this many
+    // bytes have been appended since the last compaction
+    if (!strcmp(argv[i], "--wal-compact-bytes"))
+      compact_bytes = atoll(argv[i + 1]);
   }
   signal(SIGPIPE, SIG_IGN);
   if (wal[0]) {
@@ -190,6 +204,7 @@ int main(int argc, char** argv) {
     fclose(f);
   }
   g_coord = new edl::Coordinator(ttl, wal);
+  if (compact_bytes > 0) g_coord->SetWalCompactBytes(compact_bytes);
 
   int srv = socket(AF_INET, SOCK_STREAM, 0);
   int one = 1;

@@ -252,3 +252,92 @@ def test_incarnation_monotonic_across_restarts():
     run_worker(ctx1, lambda ctx: 0)
     ctx2 = bootstrap(c, env, barrier_timeout_s=1.0)
     assert ctx2.incarnation == 2  # restart gets a fresh incarnation
+
+
+@pytest.mark.skipif(not HAVE_NATIVE, reason="no C++ toolchain")
+def test_wal_compaction_bounds_bytes(tmp_path):
+    """The WAL must stay O(state), not O(history) (VERDICT r3 weak #3):
+    once appended bytes cross the compaction threshold the coordinator
+    snapshots its full state and truncates, so a long job's restart
+    replays a snapshot + short suffix instead of its entire mutation
+    history. The bound holds THROUGHOUT a soak of step-scoped KV churn
+    and queue traffic, and recovery from snapshot+suffix is exact."""
+    import os
+
+    wal = str(tmp_path / "c.wal")
+    c = coord_mod.NativeCoordinator(5.0, wal_path=wal)
+    c.set_wal_compact_bytes(8192)
+    c.register("w0", 1)
+    c.register("w1", 2)
+    c.queue_init(6400, 32, passes=1, lease_timeout_s=16.0)
+    # soak: 200 tasks x (lease + 2 KV puts + ack) ≈ 25 KB of raw WAL
+    # traffic — several compactions at an 8 KB threshold
+    while True:
+        t = c.lease("w0")
+        if t is None:
+            break
+        c.kv_put("go/0", f"{t.task_id}:step")
+        c.kv_put(f"ckmark/{t.task_id % 7}", "x")
+        c.ack(t.task_id)
+        # bound: snapshot(state ≈ 200 task lines ≈ 5 KB) + threshold
+        assert os.path.getsize(wal) < 8192 + 8192, os.path.getsize(wal)
+    stats = c.wal_stats()
+    assert stats["compactions"] >= 1, stats
+    assert c.queue_done()
+    before = (
+        c.epoch(),
+        c.queue_stats(),
+        [(m.name, m.incarnation, m.rank) for m in c.members()],
+        c.kv_get("go/0"),
+    )
+    # explicit compact + post-snapshot suffix: recovery must see both
+    c.wal_compact()
+    c.kv_put("after_snapshot", "1")
+    c.close()
+
+    r = coord_mod.NativeCoordinator(5.0, wal_path=wal)
+    assert (
+        r.epoch(),
+        r.queue_stats(),
+        [(m.name, m.incarnation, m.rank) for m in r.members()],
+        r.kv_get("go/0"),
+    ) == before
+    assert r.kv_get("after_snapshot") == "1"
+    assert r.queue_done()
+    r.close()
+
+
+@pytest.mark.skipif(not HAVE_NATIVE, reason="no C++ toolchain")
+def test_wal_names_with_framing_chars_survive_restart(tmp_path):
+    """Worker/barrier names are arbitrary strings on the in-process
+    ctypes path (ADVICE r3): names containing the WAL's framing
+    characters (space, newline, backslash) must replay exactly, in
+    membership records, barrier arrivals, lease grants, and snapshots."""
+    wal = str(tmp_path / "c.wal")
+    weird = "w 0\nback\\slash\ttab\rcr"
+    c = coord_mod.NativeCoordinator(5.0, wal_path=wal)
+    c.register(weird, 1)
+    c.register("plain", 1)
+    c.barrier_arrive("bar rier\n", weird)
+    c.queue_init(64, 32, passes=1, lease_timeout_s=16.0)
+    t = c.lease(weird)
+    assert t is not None
+    before = [(m.name, m.incarnation, m.rank) for m in c.members()]
+    c.close()
+
+    r = coord_mod.NativeCoordinator(5.0, wal_path=wal)
+    assert [(m.name, m.incarnation, m.rank) for m in r.members()] == before
+    assert r.barrier_count("bar rier\n") == 1
+    # snapshot path: compact with the weird-named LEASE still live (the
+    # snapshot's SL record carries the name), reopen again
+    r.wal_compact()
+    assert r.wal_stats()["compactions"] == 1
+    r.kv_put("tick", "1")  # post-snapshot suffix
+    r.close()
+    s = coord_mod.NativeCoordinator(5.0, wal_path=wal)
+    assert [(m.name, m.incarnation, m.rank) for m in s.members()] == before
+    assert s.barrier_count("bar rier\n") == 1
+    # the lease survived snapshot+replay under the weird worker:
+    # releasing that worker requeues exactly one task
+    assert s.release_worker(weird) == 1
+    s.close()

@@ -54,6 +54,9 @@ def test_randomized_kill_scale_schedule(tmp_path, seed):
         # virtual 2-worker slices: the slice-kill arm below can take an
         # entire slice down at once (multi-slice fault coverage)
         workers_per_slice=2,
+        # tight WAL compaction so the soak crosses snapshot+truncate
+        # cycles (incl. across the coord-restart arm)
+        wal_compact_bytes=32 * 1024,
         work_dir=str(tmp_path),
     ) as launcher:
         launcher.start(2)
@@ -171,3 +174,10 @@ def test_randomized_kill_scale_schedule(tmp_path, seed):
         assert stats["todo"] == 0 and stats["leased"] == 0, (seed, events, stats)
         assert stats["dead"] == 0, (seed, events, stats)
         assert float(launcher.kv("loss_last")) < float(launcher.kv("loss_first"))
+        # WAL stays O(state) under every schedule: bytes appended since
+        # the last snapshot never exceed the threshold by more than the
+        # snapshot itself (the exact accounting above held ACROSS those
+        # snapshot+truncate cycles — and across coordinator restarts)
+        wal_bytes = os.path.getsize(str(tmp_path / "coordinator.wal"))
+        assert wal_bytes < 128 * 1024, (seed, events, wal_bytes)
+        assert launcher.client.wal_stats()["appended_bytes"] <= wal_bytes
