@@ -364,22 +364,46 @@ def run_export_status(args) -> int:
         import shutil
 
         os.makedirs(args.fetch, exist_ok=True)
-        for f in ("params.npz", "manifest.json"):
-            shutil.copy2(os.path.join(doc["_dir"], f), args.fetch)
-        print(f"fetched -> {args.fetch}")
+        # the GC (keep=2) may delete doc["_dir"] while we copy if two
+        # newer exports publish in between — retry against the re-read
+        # latest pointer instead of dying mid-fetch (ADVICE r3)
+        for attempt in range(5):
+            try:
+                for f in ("params.npz", "manifest.json"):
+                    shutil.copy2(os.path.join(doc["_dir"], f), args.fetch)
+                break
+            except FileNotFoundError:
+                newer = export_status(args.export_dir)
+                if newer is None or newer["_dir"] == doc["_dir"] or attempt == 4:
+                    print(
+                        f"export {doc['_dir']} vanished mid-fetch",
+                        file=sys.stderr,
+                    )
+                    return 1
+                doc = newer
+        print(f"fetched -> {args.fetch} (step={doc['step']})")
     return 0
 
 
 def run_generate(args) -> int:
     """Decode from a published export — the serving consumer in one
     command (export manifest carries the architecture record; llama
-    KV-cache decode does the rest). Imports jax lazily: every other CLI
-    verb stays device-free."""
+    KV-cache decode does the rest). ``--mesh "tp=2"`` loads the params
+    SHARDED onto a device mesh (the training layout reused for
+    serving), so exports bigger than one chip's HBM serve at all.
+    Imports jax lazily: every other CLI verb stays device-free."""
     import numpy as np
 
-    from edl_tpu.runtime.export import load_export
+    from edl_tpu.runtime.export import (
+        export_status,
+        load_export,
+        load_export_sharded,
+    )
 
-    params, doc = load_export(args.export_dir)
+    doc = export_status(args.export_dir)
+    if doc is None:
+        print(f"no published export under {args.export_dir}", file=sys.stderr)
+        return 1
     model = doc.get("model") or {}
     if model.get("family") != "llama":
         print(
@@ -393,7 +417,37 @@ def run_generate(args) -> int:
 
     from edl_tpu.models import llama
 
-    cfg = llama.LlamaConfig.from_meta(model)
+    if args.mesh:
+        from edl_tpu.parallel.mesh import MeshPlan
+
+        try:
+            plan = MeshPlan.parse(args.mesh, len(jax.devices()))
+            mesh = plan.build()
+        except ValueError as e:
+            print(f"bad --mesh {args.mesh!r}: {e}", file=sys.stderr)
+            return 1
+        # pspecs derived from the SAME manifest the params load from —
+        # a publish landing mid-call cannot pair one export's config
+        # with another's weights
+        try:
+            params, doc = load_export_sharded(
+                args.export_dir,
+                mesh,
+                lambda d: llama.param_pspecs(
+                    llama.LlamaConfig.from_meta(d["model"]), plan
+                ),
+            )
+        except ValueError as e:  # raced into a non-llama export
+            print(f"export changed mid-load: {e}", file=sys.stderr)
+            return 1
+        print(f"# mesh {plan.describe()}", file=sys.stderr)
+    else:
+        params, doc = load_export(args.export_dir)
+    try:
+        cfg = llama.LlamaConfig.from_meta(doc.get("model") or {})
+    except ValueError as e:
+        print(f"export changed mid-load: {e}", file=sys.stderr)
+        return 1
     try:
         ids = [int(t) for t in args.prompt.split(",")]
     except ValueError:
@@ -571,6 +625,13 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--max-new", type=int, default=16)
     g.add_argument("--temperature", type=float, default=0.0)
     g.add_argument("--seed", type=int, default=0)
+    g.add_argument(
+        "--mesh",
+        default="",
+        help='serve sharded: MeshPlan grammar (e.g. "tp=2", "fsdp") — '
+        "params load onto the mesh with the training layout, so exports "
+        "bigger than one chip's HBM serve at all",
+    )
     g.set_defaults(fn=run_generate)
 
     return p

@@ -226,23 +226,101 @@ def export_status(root: str) -> Optional[Dict[str, Any]]:
     return doc
 
 
-def load_export(root: str) -> Tuple[Any, Dict[str, Any]]:
-    """(params tree, manifest) of the latest export. The tree is a
-    nested dict rebuilt from the flat leaf paths — exactly the structure
-    every model's ``forward`` consumes; a serving process needs no
-    TrainState, optimizer, or mesh."""
-    doc = export_status(root)
-    if doc is None:
-        raise FileNotFoundError(f"no published export under {root}")
-    params: Dict[str, Any] = {}
+def _iter_param_leaves(doc):
+    """Yield (key-parts, np array) for every leaf of an export — THE
+    npz/bf16/key-path decoding rule, shared by every load path. The zip
+    stays open across the sweep, so a concurrent GC delete (POSIX
+    unlink of an open file) cannot truncate a load mid-tree; the race
+    window is only the open, which :func:`_load_latest` retries."""
     with np.load(os.path.join(doc["_dir"], "params.npz")) as z:
         for key in z.files:
             arr = z[key]
             if doc["dtypes"].get(key) == "bfloat16":
                 arr = arr.view(_bf16())
-            node = params
-            parts = key.split("/")
-            for p in parts[:-1]:
-                node = node.setdefault(p, {})
-            node[parts[-1]] = arr
-    return params, doc
+            yield key.split("/"), arr
+
+
+def _tree_insert(tree: Dict[str, Any], parts, leaf) -> None:
+    node = tree
+    for p in parts[:-1]:
+        node = node.setdefault(p, {})
+    node[parts[-1]] = leaf
+
+
+def _load_latest(root: str, build):
+    """(build(doc), doc) against the latest pointer, retrying when the
+    keep=2 GC deletes the pointed dir between the pointer read and the
+    npz open (a trainer publishing continuously makes this race real —
+    every consumer gets the retry, not just the CLI fetch)."""
+    doc = export_status(root)
+    for _ in range(5):
+        if doc is None:
+            raise FileNotFoundError(f"no published export under {root}")
+        try:
+            return build(doc), doc
+        except FileNotFoundError:
+            newer = export_status(root)
+            if newer is None or newer["_dir"] == doc["_dir"]:
+                raise
+            doc = newer
+    raise FileNotFoundError(f"export under {root} kept vanishing mid-load")
+
+
+def load_export_sharded(root: str, mesh, pspecs) -> Tuple[Any, Dict[str, Any]]:
+    """(params tree, manifest) of the latest export, loaded DIRECTLY
+    onto a device mesh: every leaf is placed with its PartitionSpec via
+    ``jax.make_array_from_callback``, so each device materializes only
+    its own shard — the serving path for exports bigger than one chip's
+    HBM (a bf16 llama3-8b export is ~16 GB; a v5e chip has 16 GB).
+    Host RAM touches one full leaf at a time (the npz read), never the
+    whole tree at once.
+
+    ``pspecs`` is a pytree of PartitionSpec mirroring the param tree —
+    reuse the model's training layout (e.g.
+    ``llama.param_pspecs(cfg, plan)``) — or a callable ``doc ->
+    pspecs`` evaluated against the SAME manifest the params load from
+    (so an architecture read and its weights cannot come from different
+    exports when a publish lands mid-call); leaves missing from it load
+    replicated. Reference analog: the serving consumer of
+    save_inference_model (/root/reference/example/ctr/ctr/train.py:
+    169-180), which had no multi-device story at all."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def build(doc):
+        tree = pspecs(doc) if callable(pspecs) else pspecs
+
+        def spec_for(parts) -> P:
+            node = tree
+            for p in parts:
+                if not isinstance(node, dict) or p not in node:
+                    return P()
+                node = node[p]
+            return node if node is not None else P()
+
+        params: Dict[str, Any] = {}
+        for parts, arr in _iter_param_leaves(doc):
+            sharding = NamedSharding(mesh, spec_for(parts))
+            garr = jax.make_array_from_callback(
+                arr.shape, sharding, lambda idx, a=arr: a[idx]
+            )
+            _tree_insert(params, parts, garr)
+            del arr  # one full leaf on host at a time
+        return params
+
+    return _load_latest(root, build)
+
+
+def load_export(root: str) -> Tuple[Any, Dict[str, Any]]:
+    """(params tree, manifest) of the latest export. The tree is a
+    nested dict rebuilt from the flat leaf paths — exactly the structure
+    every model's ``forward`` consumes; a serving process needs no
+    TrainState, optimizer, or mesh."""
+
+    def build(doc):
+        params: Dict[str, Any] = {}
+        for parts, arr in _iter_param_leaves(doc):
+            _tree_insert(params, parts, arr)
+        return params
+
+    return _load_latest(root, build)

@@ -119,3 +119,71 @@ def test_generate_sampling_shape_and_determinism():
     assert (np.asarray(a) < cfg.vocab).all() and (np.asarray(a) >= 0).all()
     with pytest.raises(ValueError, match="PRNG key"):
         llama.generate(params, jnp.asarray(prompt), cfg, 2, temperature=0.5)
+
+
+def test_sharded_generate_matches_single_device(tmp_path, cpu_devices):
+    """Sharded serving (VERDICT r3 #3): the export loads directly onto
+    a tp×fsdp mesh via load_export_sharded — each device holds only its
+    shard of every weight (the path for exports bigger than one chip's
+    HBM) — and generate produces token-identical output."""
+    from jax.sharding import PartitionSpec as P
+
+    from edl_tpu.parallel.mesh import MeshPlan
+    from edl_tpu.runtime.export import load_export_sharded
+
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(jax.random.PRNGKey(2), cfg)
+    export_params(
+        str(tmp_path), params, step=7, dtype="float32",
+        model_meta=cfg.to_meta(),
+    )
+    plan = MeshPlan.parse("tp=2,fsdp=2,dp", 8)
+    mesh = plan.build()
+    loaded, doc = load_export_sharded(
+        str(tmp_path), mesh, llama.param_pspecs(cfg, plan)
+    )
+    assert doc["step"] == 7
+    # really sharded at rest: wq holds 1/4 per device (fsdp x tp)
+    wq = loaded["layers"]["wq"]
+    assert wq.sharding.spec == P(None, "fsdp", "tp")
+    assert {s.data.shape for s in wq.addressable_shards} == {
+        (cfg.n_layers, cfg.d_model // 2, cfg.n_heads * cfg.head_dim // 2)
+    }
+    prompt = np.arange(2 * 6, dtype=np.int32).reshape(2, 6) % cfg.vocab
+    got = llama.generate(loaded, jnp.asarray(prompt), cfg, max_new=6)
+    want = llama.generate(params, jnp.asarray(prompt), cfg, max_new=6)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_cli_generate_sharded_mesh(tmp_path):
+    """`edl generate --mesh tp=2` serves the export sharded over a
+    virtual device mesh and produces the same tokens as single-device."""
+    import os
+    import subprocess
+    import sys
+
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    export_params(
+        str(tmp_path), params, step=1, dtype="float32",
+        model_meta=cfg.to_meta(),
+    )
+    env = {
+        **os.environ,
+        "PYTHONPATH": os.path.dirname(os.path.dirname(__file__)),
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+    }
+    out = subprocess.run(
+        [
+            sys.executable, "-m", "edl_tpu.cli", "generate", str(tmp_path),
+            "--prompt", "1,2,3,4", "--max-new", "5", "--mesh", "tp=2",
+        ],
+        capture_output=True, text=True, env=env,
+    )
+    assert out.returncode == 0, out.stderr
+    toks = [int(t) for t in out.stdout.strip().split(",")]
+    want = llama.generate(
+        params, jnp.asarray([[1, 2, 3, 4]], jnp.int32), cfg, max_new=5
+    )
+    assert toks == [int(t) for t in np.asarray(want)[0]]
