@@ -205,6 +205,54 @@ def _llama_flagship_bench(n_dev, plan, mesh, rng) -> dict:
     }
 
 
+def _p2p_bench() -> dict:
+    """Shard-plane throughput: serve a ~128 MB host-RAM snapshot
+    through runtime/shard_server.py and fetch every piece back — the
+    transfer rate of a P2P migration reshard (host-RAM → TCP →
+    host-RAM; loopback here, DCN between hosts in production). Powers
+    p2p_migrate_stall_model in doc/reshard_stall.md."""
+    from edl_tpu.runtime import checkpoint as ck
+    from edl_tpu.runtime.checkpoint import LocalSnapshot
+    from edl_tpu.runtime.shard_server import (
+        RemotePieces,
+        ShardServer,
+        fetch_index,
+    )
+
+    n_pieces, rows = 8, 4096
+    piece = np.random.RandomState(0).rand(rows, 1024).astype(np.float32)
+    pieces = {
+        "p:w": [((i * rows, 0), piece) for i in range(n_pieces)]
+    }
+    snap = LocalSnapshot(
+        step=1,
+        pieces=pieces,
+        primary={"p:w": [o for o, _ in pieces["p:w"]]},
+        shapes={"p:w": (n_pieces * rows, 1024)},
+        dtypes={"p:w": "float32"},
+    )
+    srv = ShardServer(lambda: snap)
+    try:
+        _, entries = fetch_index(f"127.0.0.1:{srv.port}")
+        rp = RemotePieces(f"127.0.0.1:{srv.port}", entries)
+        total = 0
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            total = sum(rp[e].nbytes for e in entries)
+            best = min(best, time.perf_counter() - t0)
+        rp.close()
+    finally:
+        srv.close()
+    bw = total / best
+    return {
+        "p2p_bw_gbs": round(bw / (1 << 30), 3),
+        "stall_model_8b_migrate_s": round(
+            ck.p2p_migrate_stall_model(17 * (1 << 30), 1, bw), 1
+        ),
+    }
+
+
 def _llama_decode_bench() -> dict:
     """Serving-path metrics for the KV-cache decode (runtime/export.py
     consumer; VERDICT r3 #3): prefill latency for one [B, T0] prompt
@@ -393,6 +441,7 @@ def main() -> None:
     # reshard-stall measurements above.
     llama_metrics = _llama_flagship_bench(n_dev, plan, mesh, rng)
     llama_metrics.update(_llama_decode_bench())
+    llama_metrics.update(_p2p_bench())
 
     print(
         json.dumps(

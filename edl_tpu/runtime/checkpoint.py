@@ -29,7 +29,7 @@ import json
 import os
 import tempfile
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -196,6 +196,22 @@ def host_fallback_stall_model(
     factor = STAGE_MOMENT_FACTOR[stage]
     wire = (state_bytes - moment_bytes) + moment_bytes * factor
     return (wire / hosts_after) / host_bw_bytes_s
+
+
+def p2p_migrate_stall_model(
+    state_bytes: int, hosts_after: int, link_bw_bytes_s: float
+) -> float:
+    """Worst-case stall of a DISJOINT-set migration over the P2P shard
+    plane (runtime/shard_server.py): each of the ``hosts_after`` new
+    hosts ingests its 1/H share of the full state concurrently over its
+    data-plane network link — no storage round trip (the old path paid
+    a write AND a read of the full state through shared storage).
+    ``link_bw_bytes_s`` is per-host network bandwidth (DCN-class in
+    production; bench.py measures the shard-plane software stack as
+    ``p2p_bw_gbs``). Derivation + budget table: doc/reshard_stall.md."""
+    if hosts_after <= 0 or link_bw_bytes_s <= 0:
+        raise ValueError("hosts_after and link_bw_bytes_s must be positive")
+    return (state_bytes / hosts_after) / link_bw_bytes_s
 
 
 # -- disk format -------------------------------------------------------------
@@ -513,20 +529,23 @@ def gc_step_dirs(root: str, keep: int = 2) -> None:
 
 
 class _PieceIndex:
-    """Piece lookup across RAM snapshot + manifest-listed shard files.
-    Entry keys carry (offset, shape), so overlap against a target slice
-    is decided without I/O; disk pieces load lazily (npz members
-    decompress on access) — a process reads only the bytes its local
-    devices need."""
+    """Piece lookup across RAM snapshot + manifest-listed shard files +
+    remote peers. Entry keys carry (offset, shape), so overlap against a
+    target slice is decided without I/O; disk pieces load lazily (npz
+    members decompress on access) and remote pieces fetch lazily
+    (shard_server.RemotePieces) — a process reads only the bytes its
+    local devices need. Priority at equal offsets: disk < remote peer <
+    local RAM (same bytes everywhere; cheaper source wins)."""
 
     def __init__(
         self,
         manifest: Optional[Dict[str, Any]],
         ram: Optional[LocalSnapshot],
+        remotes: Sequence[Any] = (),
     ):
-        # {leaf key: {offset: (shape, source)}} where source is either a
-        # host array or an (NpzFile, entry) lazy handle; RAM wins over
-        # disk at equal offsets (same bytes, no I/O)
+        # {leaf key: {offset: (shape, source)}} where source is a host
+        # array or an (indexable, entry) lazy handle — NpzFile or a
+        # shard_server.RemotePieces, both fetched as src[entry]
         self._index: Dict[str, Dict[Tuple[int, ...], Tuple[Tuple[int, ...], Any]]] = {}
         self._files: List[Any] = []
         if manifest is not None:
@@ -538,6 +557,10 @@ class _PieceIndex:
                 for entry in z.files:
                     key, off, shape = _parse_piece_key(entry)
                     self._index.setdefault(key, {})[off] = (shape, (z, entry))
+        for src in remotes:
+            for entry in src.entries():
+                key, off, shape = _parse_piece_key(entry)
+                self._index.setdefault(key, {})[off] = (shape, (src, entry))
         if ram is not None:
             for key, plist in ram.pieces.items():
                 for off, arr in plist:
@@ -677,6 +700,67 @@ def load_sharded(
             {k: tuple(v) for k, v in manifest["shapes"].items()},
             manifest["dtypes"],
         )
+    finally:
+        index.close()
+
+
+def template_schema(like: TrainState) -> Tuple[Dict[str, Tuple[int, ...]], Dict[str, str]]:
+    """(shapes, dtypes) keyed like the sharded format, derived from a
+    structure template — what a PEER-only restore uses in place of a
+    manifest (shard_server P2P migration: no disk artifact exists)."""
+    shapes: Dict[str, Tuple[int, ...]] = {}
+    dtypes: Dict[str, str] = {}
+    for key, leaf in _state_leaf_items(like):
+        shapes[key] = tuple(getattr(leaf, "shape", ()))
+        dtypes[key] = np.dtype(getattr(leaf, "dtype", np.float32)).name
+    return shapes, dtypes
+
+
+def peer_coverage_ok(
+    like: TrainState, piece_entries: Sequence[str]
+) -> bool:
+    """Whether a set of piece entry keys (from peers' shard-server
+    indexes, deduped by (leaf, offset) — replicas collapse) tiles every
+    leaf of ``like`` completely. Pure key geometry, no byte transfer:
+    the go/no-go check before committing a membership to a P2P restore."""
+    shapes, _ = template_schema(like)
+    have: Dict[str, int] = {}
+    seen = set()
+    for entry in piece_entries:
+        key, off, shape = _parse_piece_key(entry)
+        if (key, off) in seen:
+            continue
+        seen.add((key, off))
+        have[key] = have.get(key, 0) + (int(np.prod(shape)) if shape else 1)
+    for key, shape in shapes.items():
+        total = int(np.prod(shape)) if shape else 1
+        if have.get(key, 0) < total:
+            return False
+    return True
+
+
+def load_from_pieces(
+    step: int,
+    like: TrainState,
+    state_shardings: TrainState,
+    ram: Optional[LocalSnapshot] = None,
+    manifest: Optional[Dict[str, Any]] = None,
+    remotes: Sequence[Any] = (),
+) -> TrainState:
+    """Assemble a TrainState at ``step`` from any mix of sources: local
+    RAM snapshot, a committed manifest AT THE SAME STEP, and remote
+    peers (shard_server.RemotePieces) — the P2P migration restore. The
+    leaf schema comes from the template, so a pure-peer restore needs
+    no disk artifact at all. Assembly is coverage-checked per slice; a
+    vanished peer surfaces as an error, never a silent hole."""
+    if ram is not None and ram.step != step:
+        ram = None
+    if manifest is not None and manifest["step"] != step:
+        manifest = None
+    index = _PieceIndex(manifest, ram, remotes=remotes)
+    shapes, dtypes = template_schema(like)
+    try:
+        return _materialize(index, step, like, state_shardings, shapes, dtypes)
     finally:
         index.close()
 

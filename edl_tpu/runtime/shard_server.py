@@ -1,0 +1,238 @@
+"""Peer-to-peer shard transfer — RAM-to-RAM state redistribution.
+
+The drain window of an elastic reshard has old and new worlds coexisting
+as live processes; the state that must change owners already sits in the
+old workers' host-RAM snapshots (checkpoint.LocalSnapshot). Moving it
+worker-to-worker over TCP rides the data-plane network (DCN between TPU
+hosts) instead of a shared-storage round trip — the reference's analog
+is pserver state living in memory across trainer membership changes
+(SURVEY §2.5 comm backend), which never touches disk either.
+
+Each worker runs one :class:`ShardServer` thread serving its CURRENT
+snapshot (the reference is swapped atomically at every reshard/commit
+snapshot). Restorers probe peers with :func:`fetch_index` and feed
+:class:`RemotePieces` handles into the checkpoint piece index —
+``_PieceIndex.assemble`` already accepts any ``src[entry]``-indexable
+source, so remote pieces participate in the same coverage-checked
+assembly as RAM and disk pieces, fetched lazily and only for the slices
+this process's devices actually need.
+
+Line protocol (length-prefixed binary payloads):
+
+    INDEX\n               -> <len>\n<json: {"step": S, "entries": {entry: dtype}}>
+    FETCH <entry>\n        -> <len>\n<raw C-order bytes>   (-1\n if unknown)
+
+Entry keys are ``checkpoint._piece_key`` strings (leaf@offsets@shape),
+so offset/extent geometry travels in the key and the index needs no
+extra metadata round trips.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from edl_tpu.runtime.checkpoint import LocalSnapshot, _parse_piece_key, _piece_key
+from edl_tpu.utils.logging import kv_logger
+
+log = kv_logger("shardsrv")
+
+_IO_TIMEOUT_S = 30.0
+
+
+def _read_line(f) -> str:
+    return f.readline().decode().rstrip("\n")
+
+
+class ShardServer:
+    """Serve this process's host-RAM snapshot pieces to peers.
+
+    ``get_snapshot`` returns the snapshot to serve (or None before the
+    first one exists); it is called per request, so the owner just keeps
+    its ``_ram_snapshot`` attribute fresh and the server follows."""
+
+    def __init__(self, get_snapshot: Callable[[], Optional[LocalSnapshot]]):
+        self._get = get_snapshot
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("0.0.0.0", 0))
+        self._srv.listen(32)
+        self.port = self._srv.getsockname()[1]
+        self._stop = threading.Event()
+        self._active = 0  # open peer connections (drain-linger signal)
+        self._active_lock = threading.Lock()
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    @property
+    def active(self) -> int:
+        with self._active_lock:
+            return self._active
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+    def _accept_loop(self) -> None:  # pragma: no cover - thread loop
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve, args=(conn,), daemon=True
+            ).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        conn.settimeout(_IO_TIMEOUT_S)
+        f = conn.makefile("rwb")
+        with self._active_lock:
+            self._active += 1
+        try:
+            while True:
+                line = _read_line(f)
+                if not line:
+                    return
+                snap = self._get()
+                if line == "INDEX":
+                    if snap is None:
+                        payload = b'{"step": -1, "entries": {}}'
+                    else:
+                        entries = {
+                            _piece_key(key, off, tuple(arr.shape)): str(
+                                arr.dtype
+                            )
+                            for key, plist in snap.pieces.items()
+                            for off, arr in plist
+                        }
+                        payload = json.dumps(
+                            {"step": snap.step, "entries": entries}
+                        ).encode()
+                    f.write(str(len(payload)).encode() + b"\n" + payload)
+                    f.flush()
+                elif line.startswith("FETCH "):
+                    arr = self._lookup(snap, line[6:])
+                    if arr is None:
+                        f.write(b"-1\n")
+                    else:
+                        raw = np.ascontiguousarray(arr).tobytes()
+                        f.write(str(len(raw)).encode() + b"\n" + raw)
+                    f.flush()
+                else:
+                    return
+        except (OSError, ValueError):
+            pass  # peer went away mid-request: its restore retries elsewhere
+        finally:
+            with self._active_lock:
+                self._active -= 1
+            try:
+                f.close()
+                conn.close()
+            except OSError:
+                pass
+
+    @staticmethod
+    def _lookup(snap: Optional[LocalSnapshot], entry: str):
+        if snap is None:
+            return None
+        key, off, shape = _parse_piece_key(entry)
+        for o, arr in snap.pieces.get(key, ()):
+            if o == off and tuple(arr.shape) == shape:
+                return arr
+        return None
+
+
+def fetch_index(
+    addr: str, timeout_s: float = 2.0
+) -> Optional[Tuple[int, Dict[str, str]]]:
+    """(step, {entry: dtype}) served by a peer, or None if unreachable —
+    a dead/departed peer is an expected outcome, not an error."""
+    host, port = addr.rsplit(":", 1)
+    try:
+        conn = socket.create_connection((host, int(port)), timeout=timeout_s)
+    except OSError:
+        return None
+    try:
+        conn.settimeout(_IO_TIMEOUT_S)
+        f = conn.makefile("rwb")
+        f.write(b"INDEX\n")
+        f.flush()
+        n = int(_read_line(f))
+        doc = json.loads(f.read(n).decode())
+        return int(doc["step"]), dict(doc["entries"])
+    except (OSError, ValueError, KeyError):
+        return None
+    finally:
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+
+class RemotePieces:
+    """Lazy piece source over one peer's ShardServer, shaped for
+    ``checkpoint._PieceIndex``: ``src[entry]`` fetches that piece's raw
+    bytes over a persistent connection and returns the ndarray. A fetch
+    failure raises — the restore's coverage check then surfaces it
+    instead of silently assembling a hole."""
+
+    def __init__(self, addr: str, entries: Dict[str, str]):
+        self.addr = addr
+        self._dtypes = entries
+        self._lock = threading.Lock()
+        self._conn = None
+        self._file = None
+
+    def entries(self):
+        return self._dtypes.keys()
+
+    def _connect(self):
+        host, port = self.addr.rsplit(":", 1)
+        self._conn = socket.create_connection(
+            (host, int(port)), timeout=_IO_TIMEOUT_S
+        )
+        self._conn.settimeout(_IO_TIMEOUT_S)
+        self._file = self._conn.makefile("rwb")
+
+    def close(self) -> None:
+        try:
+            if self._conn is not None:
+                self._conn.close()
+        except OSError:
+            pass
+        self._conn = self._file = None
+
+    def __getitem__(self, entry: str) -> np.ndarray:
+        _, _, shape = _parse_piece_key(entry)
+        dtype = np.dtype(self._dtypes[entry])
+        with self._lock:
+            for attempt in (0, 1):  # one reconnect per fetch
+                try:
+                    if self._conn is None:
+                        self._connect()
+                    self._file.write(b"FETCH " + entry.encode() + b"\n")
+                    self._file.flush()
+                    line = self._file.readline()
+                    if not line:
+                        # server idled out our connection between lazy
+                        # fetches (its 30s I/O timeout): a clean EOF —
+                        # take the reconnect path, not a parse error
+                        raise OSError("peer closed connection")
+                    n = int(line)
+                    if n < 0:
+                        raise KeyError(f"peer {self.addr} lost piece {entry}")
+                    buf = self._file.read(n)
+                    if len(buf) != n:
+                        raise OSError("short read")
+                    return np.frombuffer(buf, dtype).reshape(shape).copy()
+                except (OSError, ValueError):
+                    self.close()
+                    if attempt:
+                        raise
+        raise OSError(f"unreachable peer {self.addr}")  # pragma: no cover

@@ -127,6 +127,14 @@ class WorkerConfig:
     # the job back to the last committed checkpoint (cadence:
     # ckpt_every) — graceful reshards/stops merge first and lose nothing.
     sync_every: int = 1
+    # peer-to-peer state redistribution (shard_server.py): workers serve
+    # their host-RAM snapshots over TCP; a reshard restores owner-
+    # changing shards worker-to-worker across the drain window instead
+    # of round-tripping through shared storage, and departing workers
+    # linger (bounded) until the new world confirms restore. The data
+    # plane for a migration to a DISJOINT worker set.
+    p2p: bool = True
+    p2p_linger_s: float = 20.0
     # TPU slice this host belongs to (multi-slice topology). -1 =
     # unknown: the mesh build falls back to the hardware's own
     # ``device.slice_index`` (real multislice TPU exposes it). When set
@@ -174,6 +182,8 @@ class WorkerConfig:
             sync_every=int(e.get("EDL_SYNC_EVERY", "1")),
             export_dir=e.get("EDL_EXPORT_DIR", ""),
             export_dtype=e.get("EDL_EXPORT_DTYPE", "bfloat16"),
+            p2p=e.get("EDL_P2P", "1") != "0",
+            p2p_linger_s=float(e.get("EDL_P2P_LINGER_S", "20")),
             # MEGASCALE_SLICE_ID is what GKE injects into multislice
             # TPU pods — honoring it makes the kube path slice-aware
             # with no manifest change
@@ -477,6 +487,9 @@ class ElasticWorker:
         # must not win a race against a live host's dismissal poll.
         self._gc_keys: list = []
         self._gc_later: list = []
+        self._shard_server = None  # p2p shard service (run())
+        self._incarnation = 0  # set at bootstrap; bumped to force regroup
+        self._restore_failures = 0
 
     # -- keys ----------------------------------------------------------------
     def _k(self, *parts: str) -> str:
@@ -578,12 +591,15 @@ class ElasticWorker:
             return epoch, me.rank, world, addr, members
 
     # -- state placement -----------------------------------------------------
-    def _restore_state(self, wl, tx, plan, mesh):
-        """Committed sharded checkpoint (+RAM pieces when the step
+    def _restore_state(self, wl, tx, plan, mesh, cl=None, epoch=0, rank=0,
+                       members=()):
+        """P2P peer pieces (rank-0-brokered decision; newest covered
+        step) > committed sharded checkpoint (+RAM pieces when the step
         matches) > RAM-only (dp/single-process, no ckpt dir) > fresh
-        sharded init. All processes restore the same step: the manifest
-        is the agreed truth, so survivors whose RAM ran ahead of the
-        last commit (fsdp crash) roll back with everyone else.
+        sharded init. All processes restore the same step: the P2P
+        decision key / the manifest is the agreed truth, so survivors
+        whose RAM ran ahead of the last commit (fsdp crash) roll back
+        with everyone else.
 
         Never materializes the full state on any host: restore builds
         only local shards (make_array_from_callback), fresh init runs
@@ -601,6 +617,12 @@ class ElasticWorker:
         manifest = (
             ckpt.latest_manifest(self.cfg.ckpt_dir) if self.cfg.ckpt_dir else None
         )
+        if self.cfg.p2p and cl is not None:
+            state = self._p2p_restore(
+                cl, epoch, rank, members, like, state_sh, manifest
+            )
+            if state is not None:
+                return state, pspecs
         if manifest is not None:
             state = ckpt.load_sharded(
                 self.cfg.ckpt_dir,
@@ -629,6 +651,163 @@ class ElasticWorker:
                 out_shardings=state_sh,
             )()
         return state, pspecs
+
+    # -- P2P reshard data plane ----------------------------------------------
+
+    def _merge_shardsrv_roster(self, cl, members) -> list:
+        """Rank 0 unions the current members into the job's shard-server
+        roster (single writer per epoch: no read-modify-write races).
+        Departed workers stay listed while recent — exactly the window
+        in which a migration needs to find their lingering servers —
+        and age out of the 16-name cap."""
+        import json as _json
+
+        names = _json.loads(cl.kv_get(self._k("shardsrv_names")) or "[]")
+        for m in members:
+            if m.name in names:
+                names.remove(m.name)  # refresh recency
+            names.append(m.name)
+        # cap covers every CURRENT member (they sit at the tail, so the
+        # cap can never age out a live worker's only addr publication)
+        cap = max(16, len(members))
+        for dropped in names[:-cap]:  # GC aged-out workers' addr keys
+            cl.kv_del(self._k("shardsrv", dropped))
+        names = names[-cap:]
+        cl.kv_put(self._k("shardsrv_names"), _json.dumps(names))
+        return names
+
+    def _probe_peers(self, cl):
+        """{name: (addr, step, entries)} for every reachable shard
+        server on the roster except our own. Probes run in parallel —
+        dead entries cost one bounded connect timeout, not a serial
+        scan."""
+        import json as _json
+
+        from edl_tpu.runtime.shard_server import fetch_index
+
+        names = _json.loads(cl.kv_get(self._k("shardsrv_names")) or "[]")
+        out: Dict[str, Any] = {}
+        lock = threading.Lock()
+
+        def probe(name, addr):
+            got = fetch_index(addr, timeout_s=1.0)
+            if got is not None and got[0] >= 0:
+                with lock:
+                    out[name] = (addr, got[0], got[1])
+
+        threads = []
+        for name in names:
+            if name == self.cfg.worker_id:
+                continue
+            addr = cl.kv_get(self._k("shardsrv", name))
+            if not addr:
+                continue
+            t = threading.Thread(target=probe, args=(name, addr), daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(5.0)
+        with lock:
+            # a straggler thread (slow peer past the bounded join) must
+            # not mutate the dict the caller is iterating
+            return dict(out)
+
+    def _p2p_restore(self, cl, epoch, rank, members, like, state_sh, manifest):
+        """Restore from peers' RAM snapshots over the drain window
+        (VERDICT r3 #5). Rank 0 probes the roster, picks the NEWEST
+        step whose pieces (peers + its own RAM) tile the full state and
+        is at least as new as the committed manifest, and publishes the
+        decision; everyone assembles that step from own-RAM + manifest
+        (same step) + lazily-fetched peer pieces. Returns None when the
+        decision is to use disk/fresh (callers fall through)."""
+        from edl_tpu.runtime import checkpoint as ckpt
+        from edl_tpu.runtime.shard_server import RemotePieces
+
+        dkey = self._k("restore", str(epoch))
+        peers = None
+        if rank == 0:
+            self._merge_shardsrv_roster(cl, members)
+            peers = self._probe_peers(cl)
+            own = self._ram_snapshot
+            m_step = int(manifest["step"]) if manifest is not None else -1
+            cand = sorted(
+                {s for (_, s, _) in peers.values()}
+                | ({own.step} if own is not None else set()),
+                reverse=True,
+            )
+            decision = "none"
+            for s in cand:
+                if s < m_step:
+                    break  # never restore older than the committed truth
+                entries = [
+                    e
+                    for (_, ps, es) in peers.values()
+                    if ps == s
+                    for e in es
+                ]
+                if own is not None and own.step == s:
+                    entries += [
+                        ckpt._piece_key(k, o, tuple(a.shape))
+                        for k, plist in own.pieces.items()
+                        for o, a in plist
+                    ]
+                if ckpt.peer_coverage_ok(like, entries):
+                    decision = f"p2p:{s}"
+                    break
+            cl.kv_put(dkey, decision)
+        else:
+            deadline = time.monotonic() + self.cfg.rendezvous_timeout_s
+            rank0 = next((m.name for m in members if m.rank == 0), None)
+            decision = cl.kv_get(dkey)
+            while decision is None:
+                # bail fast instead of burning the whole rendezvous
+                # timeout: a DEAD rank 0 can never publish (same rule
+                # as _await_go), and an epoch bump means the group is
+                # regrouping anyway — unlike a step verb, an unpublished
+                # RESTORE decision cannot have a collective in flight,
+                # so abandoning it strands nobody
+                cl.expire()
+                if rank0 not in {m.name for m in cl.members()}:
+                    raise RuntimeError(
+                        "rank-0 worker died before the restore decision"
+                    )
+                if cl.epoch() != epoch:
+                    raise RuntimeError(
+                        "membership moved before the restore decision"
+                    )
+                if time.monotonic() > deadline:
+                    raise TimeoutError("no restore decision from rank 0")
+                time.sleep(_POLL_S)
+                decision = cl.kv_get(dkey)
+        # GC one epoch LATE (_gc_later): rank 0 reaches the next GC
+        # point while same-epoch peers may still be polling this key —
+        # deleting it now would strand them for the full timeout
+        self._gc_later.append(dkey)
+        # observability (tests/monitor): how the LAST restore happened
+        if rank == 0:
+            cl.kv_put(self._k("restore_last"), decision)
+        if not decision.startswith("p2p:"):
+            return None
+        step = int(decision[4:])
+        if peers is None:
+            peers = self._probe_peers(cl)
+        remotes = [
+            RemotePieces(addr, entries)
+            for (addr, s, entries) in peers.values()
+            if s == step
+        ]
+        try:
+            state = ckpt.load_from_pieces(
+                step, like, state_sh,
+                ram=self._ram_snapshot,
+                manifest=manifest,
+                remotes=remotes,
+            )
+        finally:
+            for r in remotes:
+                r.close()
+        log.info("restored via p2p", step=step, peers=len(remotes))
+        return state
 
     def _join_pending_commit(self) -> None:
         """At most ONE background commit is in flight; the next commit,
@@ -887,7 +1066,21 @@ class ElasticWorker:
             self.client.kv_put(
                 self._k("slice", cfg.worker_id), str(cfg.slice_id)
             )
+        if cfg.p2p:
+            # serve our host-RAM snapshot to peers (P2P reshard data
+            # plane); published before registration like the slice id.
+            # EDL_HOST_ADDR is the reachable address of this host
+            # (pod IP in production; loopback for local jobs).
+            from edl_tpu.runtime.shard_server import ShardServer
+
+            self._shard_server = ShardServer(lambda: self._ram_snapshot)
+            self.client.kv_put(
+                self._k("shardsrv", cfg.worker_id),
+                f"{os.environ.get('EDL_HOST_ADDR', '127.0.0.1')}:"
+                f"{self._shard_server.port}",
+            )
         ctx = entrypoint.bootstrap(self.client)
+        self._incarnation = ctx.incarnation
         heartbeat_stop = self._start_heartbeat(ctx.incarnation)
         try:
             return self._epochs(cfg, jax, MeshPlan, wl, tx)
@@ -998,7 +1191,79 @@ class ElasticWorker:
                     f"axes with chips per worker"
                 )
             self._local_rows = rows // world
-            state, pspecs = self._restore_state(wl, tx, plan, mesh)
+            try:
+                state, pspecs = self._restore_state(
+                    wl, tx, plan, mesh, cl=cl, epoch=epoch, rank=rank,
+                    members=members,
+                )
+            except Exception as e:
+                # a P2P source died between decision and fetch (or the
+                # decision timed out). Peers who DID restore may already
+                # be in the step loop with a world-size program that
+                # includes us — quietly retrying would strand them in a
+                # collective. Bump our incarnation: the epoch change
+                # sends everyone back through reshard (their fresh
+                # snapshots re-seed the next decision), and we regroup.
+                restore_failures = getattr(self, "_restore_failures", 0) + 1
+                self._restore_failures = restore_failures
+                log.warn(
+                    "state restore failed; regrouping",
+                    error=str(e), failures=restore_failures,
+                )
+                _shutdown_distributed()
+                _clear_backends()
+                if restore_failures >= 3:
+                    raise
+                if cl.epoch() == epoch:
+                    # membership hasn't moved on its own (e.g. a peer's
+                    # server vanished without its TTL expiring yet):
+                    # force the bump so nobody strands in a collective.
+                    # The incarnation KV is the monotonic owner
+                    # (entrypoint.bootstrap): write through it so a
+                    # later process restart cannot reuse this value and
+                    # silently fail to bump the epoch.
+                    inc_key = self._k("incarnation", self.cfg.worker_id)
+                    self._incarnation = (
+                        max(self._incarnation, int(cl.kv_get(inc_key) or "0"))
+                        + 1
+                    )
+                    cl.kv_put(inc_key, str(self._incarnation))
+                    cl.register(self.cfg.worker_id, self._incarnation)
+                continue
+            self._restore_failures = 0
+            # confirm the restore to any lingering leavers (they serve
+            # P2P pieces until the new world is safely up). EVERY member
+            # marks its own restore; rank 0 collects the marks before
+            # advancing restored_step — publishing after only its own
+            # restore would release the leavers while a slower peer is
+            # still mid-fetch (connection reset, failed epoch).
+            rmark = lambda n: self._k("restored", str(epoch), n)  # noqa: E731
+            cl.kv_put(rmark(cfg.worker_id), "1")
+            # _gc_later, NOT _gc_keys: this epoch's own GC drain runs
+            # before rank 0 finishes collecting the marks
+            self._gc_later.append(rmark(cfg.worker_id))
+            if rank == 0:
+                deadline = time.monotonic() + cfg.rendezvous_timeout_s
+                confirmed = False
+                while time.monotonic() < deadline:
+                    cl.expire()
+                    alive = {m.name for m in cl.members()}
+                    if all(
+                        cl.kv_get(rmark(m.name)) or m.name not in alive
+                        for m in members
+                    ):
+                        confirmed = True
+                        break
+                    if cl.epoch() != epoch:
+                        break  # a peer died mid-restore: regrouping anyway
+                    time.sleep(_POLL_S)
+                if confirmed:
+                    # leavers' linger is bounded by p2p_linger_s, so an
+                    # unconfirmed epoch cannot strand them — but only a
+                    # CONFIRMED restore may release them early
+                    s = int(jax.device_get(state.step))
+                    if s > int(cl.kv_get(self._k("restored_step")) or "-1"):
+                        cl.kv_put(self._k("restored_step"), str(s))
             loss_fn = wl.loss_for(plan, mesh)
             # donate=False: after a failed collective (peer crash) the
             # pre-step buffers must still be alive to recover from.
@@ -1340,7 +1605,35 @@ class ElasticWorker:
         cl.release_worker(self.cfg.worker_id)
         cl.leave(self.cfg.worker_id)
         cl.kv_del(self._k("leaving", self.cfg.worker_id))
+        self._linger_for_migration(cl)
         return code
+
+    def _linger_for_migration(self, cl) -> None:
+        """Drain-window P2P: after deregistering (so the new epoch can
+        form), keep the process alive serving our RAM snapshot until the
+        new world confirms it restored a step >= ours — the data plane
+        of a migration to a disjoint worker set. Bounded by
+        p2p_linger_s, extended while a peer is actively fetching."""
+        snap = self._ram_snapshot
+        srv = self._shard_server
+        if not self.cfg.p2p or snap is None or srv is None:
+            return
+        deadline = time.monotonic() + self.cfg.p2p_linger_s
+        while True:
+            try:
+                restored = int(cl.kv_get(self._k("restored_step")) or "-1")
+            except Exception:
+                return  # coordinator gone: the job is over
+            if restored >= snap.step:
+                return
+            if time.monotonic() > deadline and srv.active == 0:
+                log.warn(
+                    "departing without restore confirmation",
+                    snapshot_step=snap.step,
+                    restored_step=restored,
+                )
+                return
+            time.sleep(0.1)
 
 
 def main(argv=None) -> int:

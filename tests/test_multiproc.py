@@ -603,3 +603,49 @@ def test_slice_major_reorder_interleaved(tmp_path):
         order = (launcher.kv("mesh_slices") or "").split(",")
         assert order == ["0"] * 4 + ["1"] * 4, order
         assert float(launcher.kv("loss_last")) < float(launcher.kv("loss_first"))
+
+
+def test_migration_to_disjoint_workers_via_p2p(tmp_path):
+    """Full job migration (VERDICT r3 #5): the job moves to a DISJOINT
+    worker set mid-run. Owner-changing fsdp shards travel worker-to-
+    worker over the P2P shard servers during the drain window — the
+    departing workers linger serving their RAM snapshots until the new
+    world confirms restore — instead of round-tripping through shared
+    storage. The restore decision is observable (restore_last), and the
+    job completes on the new workers with exact accounting."""
+    import signal as _signal
+
+    with ProcessJobLauncher(
+        job="mpmig",
+        model="llama",
+        mesh="fsdp",
+        min_workers=2,
+        max_workers=4,
+        n_samples=768,
+        passes=1,
+        per_device_batch=8,
+        local_devices=2,
+        seq_len=32,
+        step_sleep_s=0.25,
+        work_dir=str(tmp_path),
+        extra_env={"EDL_VOCAB": "512"},
+    ) as launcher:
+        launcher.start(2)  # w000, w001
+        launcher.wait_progress(2, timeout_s=240)
+        # migrate: two fresh workers join, both originals drain
+        launcher.spawn()  # w002
+        launcher.spawn()  # w003
+        launcher.kill("w000", sig=_signal.SIGTERM)
+        launcher.kill("w001", sig=_signal.SIGTERM)
+        rcs = launcher.wait(timeout_s=480)
+        _assert_succeeded(launcher, rcs)
+        assert len(rcs) == 4  # originals drained cleanly (exit 0)
+        assert int(launcher.kv("reshards") or "0") >= 1
+        # the post-migration restore came from peers, not disk
+        assert (launcher.kv("restore_last") or "").startswith("p2p:"), (
+            launcher.kv("restore_last")
+        )
+        stats = launcher.client.queue_stats()
+        assert stats["done"] == 768 // 16, stats
+        assert stats["dead"] == 0 and stats["todo"] == 0
+        assert float(launcher.kv("loss_last")) < float(launcher.kv("loss_first"))

@@ -1,0 +1,162 @@
+"""P2P shard transfer (runtime/shard_server.py) — the reshard data
+plane that moves owner-changing state worker-to-worker across the drain
+window instead of through shared storage (VERDICT r3 #5)."""
+
+import numpy as np
+import pytest
+
+from edl_tpu.runtime import checkpoint as ckpt
+from edl_tpu.runtime.checkpoint import LocalSnapshot, _piece_key
+from edl_tpu.runtime.shard_server import (
+    RemotePieces,
+    ShardServer,
+    fetch_index,
+)
+
+
+def _snap(step, pieces):
+    shapes = {
+        k: tuple(
+            max(o[i] + a.shape[i] for o, a in plist)
+            for i in range(plist[0][1].ndim)
+        )
+        for k, plist in pieces.items()
+    }
+    return LocalSnapshot(
+        step=step,
+        pieces=pieces,
+        primary={k: [o for o, _ in v] for k, v in pieces.items()},
+        shapes=shapes,
+        dtypes={
+            k: str(plist[0][1].dtype) for k, plist in pieces.items()
+        },
+    )
+
+
+def test_server_index_and_fetch_roundtrip():
+    w = np.arange(12, dtype=np.float32).reshape(3, 4)
+    snap = _snap(5, {"p:w": [((0, 0), w)]})
+    srv = ShardServer(lambda: snap)
+    try:
+        step, entries = fetch_index(f"127.0.0.1:{srv.port}")
+        assert step == 5
+        assert set(entries) == {_piece_key("p:w", (0, 0), (3, 4))}
+        rp = RemotePieces(f"127.0.0.1:{srv.port}", entries)
+        got = rp[next(iter(entries))]
+        np.testing.assert_array_equal(got, w)
+        # unknown piece is a clean KeyError (not a hang/hole)
+        with pytest.raises(KeyError):
+            rp[_piece_key("p:missing", (0,), (4,))]
+        rp.close()
+    finally:
+        srv.close()
+
+
+def test_server_follows_snapshot_swap():
+    """The server serves whatever the owner's CURRENT snapshot is —
+    reshard updates are visible without replumbing."""
+    holder = {"snap": None}
+    srv = ShardServer(lambda: holder["snap"])
+    try:
+        step, entries = fetch_index(f"127.0.0.1:{srv.port}")
+        assert step == -1 and entries == {}
+        holder["snap"] = _snap(9, {"p:b": [((0,), np.ones(4, np.int64))]})
+        step, entries = fetch_index(f"127.0.0.1:{srv.port}")
+        assert step == 9 and len(entries) == 1
+    finally:
+        srv.close()
+
+
+def test_peer_coverage_geometry():
+    import jax
+
+    from edl_tpu.train.trainer import TrainState
+
+    import optax
+
+    params = {"w": np.zeros((4, 4), np.float32)}
+    like = jax.eval_shape(
+        lambda: TrainState.create(params, optax.sgd(0.1))
+    )
+    full = [
+        _piece_key("p:w", (0, 0), (2, 4)),
+        _piece_key("p:w", (2, 0), (2, 4)),
+    ]
+    opt_keys = [
+        k
+        for k, _ in ckpt._state_leaf_items(like)
+        if k.startswith("o:")
+    ]
+    full += [_piece_key(k, (0, 0), (4, 4)) for k in opt_keys]
+    assert ckpt.peer_coverage_ok(like, full)
+    # replicas at the same offset dedupe, not double-count
+    assert ckpt.peer_coverage_ok(like, full + full)
+    # a missing tile fails the check
+    assert not ckpt.peer_coverage_ok(like, full[1:])
+
+
+def test_pure_peer_restore_reassembles_state(cpu_devices):
+    """load_from_pieces with ONLY remote sources (no manifest, no local
+    RAM) rebuilds the exact state on a new mesh — the disjoint-worker
+    migration in miniature: two 'old workers' each serve half the fsdp
+    shards; the 'new worker' assembles both halves over TCP."""
+    import jax
+    import optax
+
+    from edl_tpu.parallel import sharding as shd
+    from edl_tpu.parallel.mesh import MeshPlan
+    from edl_tpu.train.trainer import TrainState, shard_state, state_pspecs
+
+    params = {
+        "w": np.arange(64, dtype=np.float32).reshape(8, 8),
+        "b": np.arange(8, dtype=np.float32),
+    }
+    tx = optax.adam(1e-2)
+    plan = MeshPlan.create(fsdp=4)
+    mesh = plan.build(cpu_devices[:4])
+    state = shard_state(TrainState.create(params, tx), plan, mesh)
+    snap = ckpt.snapshot_local(state)
+
+    # split the pieces across two virtual old workers by offset parity
+    def half(i):
+        pieces = {}
+        for key, plist in snap.pieces.items():
+            mine = [p for j, p in enumerate(sorted(plist)) if j % 2 == i]
+            if mine:
+                pieces[key] = mine
+        return LocalSnapshot(
+            step=snap.step, pieces=pieces, primary={},
+            shapes=snap.shapes, dtypes=snap.dtypes,
+        )
+
+    servers = [ShardServer(lambda h=half(i): h) for i in range(2)]
+    try:
+        remotes = []
+        for srv in servers:
+            step, entries = fetch_index(f"127.0.0.1:{srv.port}")
+            assert step == snap.step
+            remotes.append(RemotePieces(f"127.0.0.1:{srv.port}", entries))
+        # coverage across BOTH halves holds; either alone does not
+        like = jax.eval_shape(lambda: TrainState.create(params, tx))
+        both = [e for r in remotes for e in r.entries()]
+        assert ckpt.peer_coverage_ok(like, both)
+        assert not ckpt.peer_coverage_ok(like, list(remotes[0].entries()))
+
+        new_plan = MeshPlan.create(dp=2)
+        new_mesh = new_plan.build(cpu_devices[4:6])
+        new_sh = shd.named(state_pspecs(like, new_plan, None), new_mesh)
+        restored = ckpt.load_from_pieces(
+            snap.step, like, new_sh, remotes=remotes
+        )
+        np.testing.assert_array_equal(
+            np.asarray(restored.params["w"]), params["w"]
+        )
+        np.testing.assert_array_equal(
+            np.asarray(restored.params["b"]), params["b"]
+        )
+        assert int(restored.step) == snap.step
+        for r in remotes:
+            r.close()
+    finally:
+        for srv in servers:
+            srv.close()
