@@ -152,5 +152,111 @@ def main():
     )
 
 
+def long_decomposition():
+    """Standalone-vs-in-model attention decomposition at the
+    LONG-CONTEXT rung (T=8192, b=4) — the VERDICT r3 #6 question:
+    the kernel measures ~30+ TF/s standalone but the in-model effective
+    rate looked ~7 TF/s. Method: (a) measure the standalone kernel at
+    exactly the in-model shape and counts (under full remat each layer
+    runs fwd twice — forward + recompute — plus the dq and dk/dv
+    sweeps); (b) measure the full train step; (c) measure the train
+    step with attention ABLATED (q passthrough — same shapes, every
+    matmul/norm/remat identical, zero attention math). in-model
+    attention cost = (b) - (c), to be compared against (a)'s
+    prediction. Run: python scripts/exp_breakdown.py long"""
+    import optax
+
+    from edl_tpu.ops import flash_attention as fa
+    from edl_tpu.train.trainer import TrainState, make_train_step
+    from edl_tpu.parallel.mesh import MeshPlan
+
+    rng = np.random.RandomState(0)
+    Bl, Tl = 4, 8192
+    print(f"\n== long-context decomposition B={Bl} T={Tl} ==", flush=True)
+    q = jnp.asarray(rng.standard_normal((Bl, Tl, 16, 128)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((Bl, Tl, 8, 128)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((Bl, Tl, 8, 128)), jnp.bfloat16)
+    att_flops = Bl * 16 * (Tl * Tl / 2) * 4 * 128
+
+    f_fwd = timeit(
+        jax.jit(lambda q, k, v: fa.attention_auto(q, k, v, causal=True)),
+        q, k, v,
+    )
+    print(f"standalone fwd      {f_fwd*1e3:8.1f} ms  "
+          f"{att_flops/f_fwd/1e12:5.1f} TF/s", flush=True)
+    f_fb = timeit(
+        jax.jit(jax.grad(
+            lambda q, k, v: fa.attention_auto(q, k, v, causal=True)
+            .astype(jnp.float32).sum(), (0, 1, 2)
+        )),
+        q, k, v,
+    )
+    print(f"standalone fwd+bwd  {f_fb*1e3:8.1f} ms  "
+          f"{3*att_flops/f_fb/1e12:5.1f} TF/s", flush=True)
+    del q, k, v
+    jax.clear_caches()
+
+    # in-model: full step vs attention-ablated step
+    cfg = llama.LlamaConfig(
+        vocab=32768, d_model=2048, n_layers=16, n_heads=16, n_kv_heads=8,
+        d_ff=6144, dtype=jnp.bfloat16, use_flash=True, remat=True,
+    )
+    plan = MeshPlan.data_parallel(1)
+    mesh = plan.build()
+    tx = optax.adafactor(1e-3)
+    batch = llama.synthetic_tokens(rng, Bl, Tl, cfg.vocab)
+    times = {}
+    real_attention = llama.attention
+    for name, attn in (
+        ("full step", real_attention),
+        ("attention ablated", lambda q, k, v, cfg, mesh=None, sp=1: q),
+    ):
+        llama.attention = attn
+        try:
+            state = jax.jit(
+                lambda: TrainState.create(
+                    llama.init_params(jax.random.PRNGKey(1), cfg), tx
+                )
+            )()
+            from edl_tpu.train.trainer import global_batch
+
+            step = make_train_step(
+                llama.make_loss_fn(cfg), tx, plan, mesh, None
+            )
+            gb = global_batch(batch, plan, mesh)
+            state, m = step(state, gb)
+            fence(m["loss"])
+            best = float("inf")
+            for _ in range(2):
+                t0 = time.perf_counter()
+                for _ in range(2):
+                    state, m = step(state, gb)
+                fence(m["loss"])
+                best = min(best, (time.perf_counter() - t0) / 2)
+            times[name] = best
+            print(f"{name:18s} {best*1e3:8.1f} ms/step", flush=True)
+            del state
+        finally:
+            llama.attention = real_attention
+            jax.clear_caches()
+    in_model = times["full step"] - times["attention ablated"]
+    # per step, per layer: fwd runs twice under full remat + one bwd
+    pred = cfg.n_layers * (2 * f_fwd + (f_fb - f_fwd))
+    print(
+        f"in-model attention  {in_model*1e3:8.1f} ms  vs standalone "
+        f"prediction L*(2*fwd + bwd) = {pred*1e3:.1f} ms", flush=True,
+    )
+    print(
+        f"# effective in-model rate "
+        f"{cfg.n_layers*3*att_flops/in_model/1e12:.1f} TF/s over "
+        f"3*att_flops; gap vs prediction = "
+        f"{(in_model - pred)*1e3:+.1f} ms (integration overhead)",
+        flush=True,
+    )
+
+
 if __name__ == "__main__":
-    main()
+    if "long" in sys.argv[1:]:
+        long_decomposition()
+    else:
+        main()
