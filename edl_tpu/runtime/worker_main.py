@@ -135,6 +135,12 @@ class WorkerConfig:
     # plane for a migration to a DISJOINT worker set.
     p2p: bool = True
     p2p_linger_s: float = 20.0
+    # held-out eval split (runtime/shards.py dataset dir): the commit
+    # leader evaluates every published export against it and publishes
+    # eval_metric in KV — the AUC-in-the-train-loop analog (reference:
+    # example/ctr/ctr/train.py:161-167). Requires export_dir and a
+    # workload that defines eval_fn.
+    eval_dir: str = ""
     # TPU slice this host belongs to (multi-slice topology). -1 =
     # unknown: the mesh build falls back to the hardware's own
     # ``device.slice_index`` (real multislice TPU exposes it). When set
@@ -184,6 +190,7 @@ class WorkerConfig:
             export_dtype=e.get("EDL_EXPORT_DTYPE", "bfloat16"),
             p2p=e.get("EDL_P2P", "1") != "0",
             p2p_linger_s=float(e.get("EDL_P2P_LINGER_S", "20")),
+            eval_dir=e.get("EDL_EVAL_DIR", ""),
             # MEGASCALE_SLICE_ID is what GKE injects into multislice
             # TPU pods — honoring it makes the kube path slice-aware
             # with no manifest change
@@ -217,6 +224,9 @@ class Workload:
     # rides export manifests so a serving consumer can rebuild the
     # model (CLI: `edl generate`)
     model_meta: Optional[Dict[str, Any]] = None
+    # held-out evaluation ``f(params, rows) -> float`` run by the
+    # commit leader on every published export (cfg.eval_dir)
+    eval_fn: Optional[Callable[[Any, Dict[str, np.ndarray]], float]] = None
 
     def loss_for(self, plan, mesh) -> Callable:
         return self.make_loss(plan, mesh) if self.make_loss else self.loss_fn
@@ -236,10 +246,15 @@ def _linreg_workload(cfg: WorkerConfig) -> Workload:
         y = x @ w_true + 0.1 * r.randn(end - start, 1).astype(np.float32)
         return {"x": x, "y": y}
 
+    def eval_rmse(params, rows):
+        pred = np.asarray(linreg.predict(params, rows["x"]))
+        return float(np.sqrt(np.mean((pred - rows["y"]) ** 2)))
+
     return Workload(
         lambda: linreg.init_params(jax.random.PRNGKey(cfg.seed)),
         linreg.loss_fn,
         batch_fn,
+        eval_fn=eval_rmse,
     )
 
 
@@ -490,6 +505,8 @@ class ElasticWorker:
         self._shard_server = None  # p2p shard service (run())
         self._incarnation = 0  # set at bootstrap; bumped to force regroup
         self._restore_failures = 0
+        self._eval_fn = None  # workload eval hook (run(), cfg.eval_dir)
+        self._eval_rows = None  # held-out split, loaded once
 
     # -- keys ----------------------------------------------------------------
     def _k(self, *parts: str) -> str:
@@ -809,6 +826,30 @@ class ElasticWorker:
         log.info("restored via p2p", step=step, peers=len(remotes))
         return state
 
+    def _eval_export(self, client, step: int) -> None:
+        """Held-out evaluation on every published export (the leader,
+        host-side, behind the step loop): reference parity for AUC
+        fetched in the train loop (example/ctr/ctr/train.py:161-167).
+        Needs cfg.eval_dir (a runtime/shards.py dataset) and a workload
+        eval_fn; publishes ``eval_metric`` = "<step>:<value>" in KV for
+        the monitor/CLI and logs it."""
+        cfg = self.cfg
+        if not cfg.eval_dir or self._eval_fn is None:
+            return
+        try:
+            from edl_tpu.runtime.export import load_export
+            from edl_tpu.runtime.shards import FileShardSource
+
+            if self._eval_rows is None:
+                src = FileShardSource(cfg.eval_dir)
+                self._eval_rows = src.fetch_range(0, src.n_samples)
+            params, _ = load_export(cfg.export_dir)
+            metric = float(self._eval_fn(params, self._eval_rows))
+            client.kv_put(self._k("eval_metric"), f"{step}:{metric:.6f}")
+            log.info("eval", step=step, metric=round(metric, 6))
+        except Exception as e:  # pragma: no cover - eval is best-effort
+            log.warn("export eval failed", error=str(e))
+
     def _join_pending_commit(self) -> None:
         """At most ONE background commit is in flight; the next commit,
         a crash rescue, or an epoch teardown serializes behind it."""
@@ -950,6 +991,7 @@ class ElasticWorker:
                                     dir=d,
                                     step=snap.step,
                                 )
+                                self._eval_export(client, snap.step)
                         except Exception as e:  # pragma: no cover
                             log.error("export failed", error=str(e))
                 else:  # pragma: no cover - crash-timing path
@@ -1045,6 +1087,15 @@ class ElasticWorker:
 
         wl = WORKLOADS[cfg.model](cfg)
         self._model_meta = wl.model_meta
+        self._eval_fn = wl.eval_fn
+        if cfg.eval_dir and self._eval_fn is None:
+            # surface the misconfiguration once: otherwise EDL_EVAL_DIR
+            # on a workload without an eval hook is a silent no-op
+            log.warn(
+                "EDL_EVAL_DIR set but workload defines no eval_fn; "
+                "no eval_metric will be published",
+                model=cfg.model,
+            )
         if cfg.data_dir:
             # real on-disk data: leased [start, end) ranges read shard
             # files instead of the workload's synthetic generator
