@@ -745,6 +745,20 @@ class KubeJobSource:
                         if self._stop:
                             return
                         continue
+                    if ev.get("type") == "BOOKMARK":
+                        # progress marker, not a change: advance the
+                        # resume point so a reconnect after a quiet
+                        # period does not replay (or 410 on) history —
+                        # never queue it as an object event
+                        rv = (
+                            ev.get("object", {})
+                            .get("metadata", {})
+                            .get("resourceVersion")
+                        )
+                        if rv:
+                            with self._lock:
+                                self._rv = rv
+                        continue
                     if ev.get("type") == "ERROR":
                         # e.g. 410 Gone: the resume point expired —
                         # die; the next poll() relists and restarts us

@@ -83,6 +83,18 @@ def staged_reshard(
     stage = stage or os.environ.get("EDL_RESHARD_STAGE", "int8")
     if stage not in ("int8", "bf16", "f32"):
         raise ValueError(f"unknown reshard staging mode {stage!r}")
+    if stage != "f32":
+        # lossy staging is the default for the stall win — make every
+        # activation of it visible so operators know the optimizer
+        # moments were perturbed (ADVICE r3; exactness callers pin
+        # stage="f32")
+        from edl_tpu.utils.logging import kv_logger
+
+        kv_logger("checkpoint").info(
+            "staged reshard with lossy moment compression",
+            stage=stage,
+            override="EDL_RESHARD_STAGE=f32 for exact staging",
+        )
     sharding_tree = shd.named(state_pspecs(state, plan, param_pspecs), mesh)
     leaves, treedef = jax.tree_util.tree_flatten(state)
     sh_leaves = treedef.flatten_up_to(sharding_tree)

@@ -60,6 +60,14 @@ class _State:
         self.rv += 1
         return str(self.rv)
 
+    def compact_events(self, keep_last: int = 0) -> None:
+        """Drop all but the newest ``keep_last`` journal events — the
+        etcd-compaction analog. A watch resuming from an rv older than
+        the journal head then gets a 410 Gone ERROR event mid-stream
+        (k8s semantics), forcing the client through its relist path."""
+        with self.lock:
+            self.events = self.events[len(self.events) - keep_last:] if keep_last else []
+
     def record(self, key, ns: str, name: str, etype: str, obj: dict) -> None:
         """Append a watch event (caller holds the lock). The event's
         object carries the event's own resourceVersion — as in k8s,
@@ -211,21 +219,64 @@ class FakeKubeServer:
                 self.end_headers()
                 deadline = time.monotonic() + timeout
                 sent = since
+                last_bookmark = time.monotonic()
                 try:
                     while time.monotonic() < deadline:
                         with state.lock:
+                            # etcd-compaction semantics: a resume point
+                            # older than the journal head is GONE — the
+                            # server answers with a 410 ERROR event and
+                            # the client must relist (k8s contract)
+                            if state.events and sent < state.events[0]["rv"] - 1:
+                                line = json.dumps({
+                                    "type": "ERROR",
+                                    "object": {
+                                        "kind": "Status",
+                                        "code": 410,
+                                        "reason": "Expired",
+                                        "message": (
+                                            f"too old resource version: "
+                                            f"{sent}"
+                                        ),
+                                    },
+                                })
+                                self.wfile.write(line.encode() + b"\n")
+                                self.wfile.flush()
+                                return
                             pending = [
                                 e for e in state.events
                                 if e["rv"] > sent
                                 and e["key"] == key
                                 and (ns is None or e["ns"] == ns)
                             ]
+                            # snapshot the head INSIDE the lock: a
+                            # bookmark may only skip rvs whose events
+                            # were visible to this pending scan
+                            head = state.rv
                         for e in pending:
                             line = json.dumps(
                                 {"type": e["type"], "object": e["object"]}
                             )
                             self.wfile.write(line.encode() + b"\n")
                             sent = max(sent, e["rv"])
+                        # periodic BOOKMARK (k8s allowWatchBookmarks):
+                        # advances the client's resume point through
+                        # quiet periods and through events of OTHER
+                        # routes, so a reconnect doesn't start from a
+                        # compactable rv
+                        if time.monotonic() - last_bookmark > 0.2:
+                            if head > sent:
+                                line = json.dumps({
+                                    "type": "BOOKMARK",
+                                    "object": {
+                                        "metadata": {
+                                            "resourceVersion": str(head)
+                                        }
+                                    },
+                                })
+                                self.wfile.write(line.encode() + b"\n")
+                                sent = max(sent, head)
+                            last_bookmark = time.monotonic()
                         # heartbeat (clients skip blank lines): makes a
                         # dead client raise BrokenPipe so the handler
                         # exits instead of idling out the whole window

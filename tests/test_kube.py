@@ -599,3 +599,108 @@ def test_watch_falls_back_to_list_diff_when_stream_dies(server, cluster):
     assert ("add", "b") in events
     assert src._watch_healthy()
     src.close()
+
+
+def test_watch_bookmarks_advance_resume_point(server, cluster):
+    """BOOKMARK events (k8s allowWatchBookmarks) advance the client's
+    resume rv through quiet periods WITHOUT being queued as object
+    events, so a later reconnect resumes from a fresh rv instead of
+    replaying (or 410ing on) history."""
+    src = KubeJobSource(cluster, watch=True)
+    events = []
+    src.poll(lambda j: None, lambda j: None, lambda j: None)
+    rv0 = int(src._rv or 0)
+    # unrelated mutations (pods) bump the server head; the trainingjob
+    # watch sees no object events, only bookmarks. record() requires
+    # the journal lock (its contract; the handlers' snapshots depend
+    # on rv-increment and append being atomic).
+    with server.state.lock:
+        for i in range(3):
+            server.state.record(
+                ("v1", "pods"), "default", f"p{i}", "ADDED",
+                {"metadata": {"name": f"p{i}", "namespace": "default"}},
+            )
+    deadline = time.monotonic() + 5
+    while int(src._rv or 0) <= rv0:
+        assert time.monotonic() < deadline, (src._rv, rv0)
+        time.sleep(0.05)
+    # no spurious object events leaked through
+    cb = lambda kind: lambda j: events.append((kind, j.name))  # noqa: E731
+    src.poll(cb("add"), cb("upd"), cb("del"))
+    assert events == []
+    # and a REAL event after the bookmarks still arrives
+    server.create_training_job(
+        {"metadata": {"name": "afterbm", "namespace": "default"},
+         "spec": {"worker": {"min_replicas": 1, "max_replicas": 2}}}
+    )
+    _poll_until(src, events, lambda e: ("add", "afterbm") in e)
+    src.close()
+
+
+def test_watch_410_error_event_on_compacted_resume(server, cluster):
+    """The fake apiserver honors etcd-compaction semantics: a watch
+    resuming from an rv older than the (compacted) journal head gets a
+    410 Gone ERROR event as its first event — the k8s contract the
+    client's recovery path is written against."""
+    server.create_training_job(
+        {"metadata": {"name": "old", "namespace": "default"},
+         "spec": {"worker": {"min_replicas": 1, "max_replicas": 2}}}
+    )
+    server.state.compact_events(keep_last=0)
+    server.create_training_job(
+        {"metadata": {"name": "new", "namespace": "default"},
+         "spec": {"worker": {"min_replicas": 1, "max_replicas": 2}}}
+    )
+    evs = list(
+        cluster.api.watch(
+            cluster.training_job_list_path(""), resource_version="1",
+            timeout_s=1.0,
+        )
+    )
+    real = [e for e in evs if e.get("type") not in ("SYNC", "HEARTBEAT")]
+    assert real and real[0]["type"] == "ERROR", evs
+    assert real[0]["object"]["code"] == 410
+
+
+def test_watch_recovers_from_mid_stream_410(server, cluster):
+    """A watch resuming from a compacted rv gets 410 Gone mid-stream:
+    the ERROR event must TERMINATE the watch loop (not hang, not be
+    applied as an object event), and the next poll RELISTS — observing
+    every change across the gap, missing nothing — then restarts a
+    healthy stream (informer semantics, reference
+    pkg/controller.go:79-108). The loop is driven synchronously so the
+    410 path is exercised deterministically, not by racing the open
+    window against the compaction."""
+    src = KubeJobSource(cluster, watch=True, watch_timeout_s=1.0)
+    events = []
+    server.create_training_job(
+        {"metadata": {"name": "during-gap", "namespace": "default"},
+         "spec": {"worker": {"min_replicas": 1, "max_replicas": 2}}}
+    )
+    server.state.compact_events(keep_last=0)
+    server.create_training_job(
+        {"metadata": {"name": "post-compact", "namespace": "default"},
+         "spec": {"worker": {"min_replicas": 1, "max_replicas": 2}}}
+    )
+    # a client that slept through the compaction: resume point far
+    # behind the journal head. Run ONE watch loop synchronously — the
+    # server answers 410, the loop must return via the ERROR path
+    # within the first window rather than stream or hang.
+    with src._lock:
+        src._rv = "1"
+    t0 = time.monotonic()
+    src._watch_loop()
+    assert time.monotonic() - t0 < 10, "watch loop hung on the 410"
+    assert not src._watch_healthy()
+    # no half-applied events from the dead stream
+    with src._lock:
+        assert all(e.get("type") != "ERROR" for e in src._events)
+
+    # recovery: relist surfaces BOTH jobs (nothing missed across the
+    # gap) and the stream comes back healthy
+    _poll_until(
+        src, events,
+        lambda e: ("add", "during-gap") in e and ("add", "post-compact") in e,
+    )
+    assert src._watch_healthy()
+    src.close()
