@@ -630,6 +630,17 @@ class PyCoordinator:
     def queue_stats(self):
         return self._queue.progress() if self._queue else {}
 
+    # WAL interface parity (duck-typed with NativeCoordinator): the
+    # Python fallback is memory-only, so these are honest no-ops
+    def wal_compact(self):
+        pass
+
+    def set_wal_compact_bytes(self, n):
+        pass
+
+    def wal_stats(self):
+        return {"appended_bytes": 0, "compactions": 0}
+
 
 def make_coordinator(member_ttl_s: float = 10.0):
     """Best available in-process coordinator: native, else Python."""
