@@ -323,7 +323,12 @@ def _llama_decode_bench() -> dict:
             "decode_config": f"B{b}/T0{t0}/new{short}-{long_}:jitter",
         }
     per_tok = (t_long - t_short) / (long_ - short)
-    prefill_s = max(t_short - short * per_tok, 0.0)
+    prefill_s = t_short - short * per_tok
+    if prefill_s < 0:
+        # per_tok over-estimated past the whole short run: the prefill
+        # extrapolation is meaningless — same failure-marker policy as
+        # the jitter branch, never a silent 0.0
+        prefill_s = -1.0
     return {
         "prefill_s": round(prefill_s, 4),
         "decode_tokens_per_sec": round(b / per_tok, 1),

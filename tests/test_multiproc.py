@@ -649,3 +649,51 @@ def test_migration_to_disjoint_workers_via_p2p(tmp_path):
         assert stats["done"] == 768 // 16, stats
         assert stats["dead"] == 0 and stats["todo"] == 0
         assert float(launcher.kv("loss_last")) < float(launcher.kv("loss_first"))
+
+
+def test_migration_across_slices_via_p2p(tmp_path):
+    """The full north-star composition: the job MIGRATES to a disjoint
+    worker set that also lives on DIFFERENT (virtual) slices — original
+    workers on slice 0, replacements spanning slices {1,2}. State moves
+    worker-to-worker over the P2P shard plane across the drain window,
+    and the post-migration mesh comes up slice-major with the pinned
+    fsdp blocks inside one slice each."""
+    import signal as _signal
+
+    with ProcessJobLauncher(
+        job="mpmigsl",
+        model="llama",
+        mesh="fsdp=2,dp",
+        min_workers=2,
+        max_workers=6,
+        n_samples=768,
+        passes=1,
+        per_device_batch=8,
+        local_devices=2,
+        seq_len=32,
+        step_sleep_s=0.25,
+        workers_per_slice=2,
+        work_dir=str(tmp_path),
+        extra_env={"EDL_VOCAB": "512"},
+    ) as launcher:
+        launcher.start(2)  # w000, w001 -> slice 0
+        launcher.wait_progress(2, timeout_s=240)
+        for _ in range(4):  # w002..w005 -> slices 1 and 2
+            launcher.spawn()
+        launcher.kill("w000", sig=_signal.SIGTERM)
+        launcher.kill("w001", sig=_signal.SIGTERM)
+        rcs = launcher.wait(timeout_s=480)
+        _assert_succeeded(launcher, rcs)
+        assert len(rcs) == 6
+        # restored from peers across the slice boundary
+        assert (launcher.kv("restore_last") or "").startswith("p2p:"), (
+            launcher.kv("restore_last")
+        )
+        # final mesh: 8 devices slice-major across slices {1, 2}, fsdp
+        # blocks (one worker's 2 devices) intact inside a slice
+        order = (launcher.kv("mesh_slices") or "").split(",")
+        assert order == ["1"] * 4 + ["2"] * 4, order
+        stats = launcher.client.queue_stats()
+        assert stats["done"] == 768 // 16, stats
+        assert stats["dead"] == 0 and stats["todo"] == 0
+        assert float(launcher.kv("loss_last")) < float(launcher.kv("loss_first"))
