@@ -32,6 +32,14 @@ def main() -> int:
     ap.add_argument("--epochs", type=int, default=None,
                     help="defaults to the manifest's spec.passes")
     ap.add_argument("--per-worker-batch", type=int, default=32)
+    ap.add_argument(
+        "--real-data",
+        action="store_true",
+        help="train on the REAL scikit-learn-bundled digits dataset "
+        "(1797 handwritten 8x8 images; the MNIST-class analog of the "
+        "reference's recognize_digits) with a held-out accuracy eval "
+        "per epoch, instead of the synthetic pattern",
+    )
     args = ap.parse_args()
 
     force_virtual_cpu(args.devices)
@@ -66,15 +74,39 @@ def main() -> int:
         args.epochs = job.spec.passes  # manifest is the single source
     if args.epochs < 1:
         ap.error(f"--epochs/spec.passes must be >= 1, got {args.epochs}")
-    # every worker must own at least one chunk: shrink chunks if the
-    # dataset is small rather than dividing by an empty shard
-    args.chunk = min(args.chunk, max(args.samples // n_workers, 1))
-
     # Static shards: worker w owns chunks w, w+N, w+2N, ... — disjoint,
     # covering every sample exactly once per epoch.
     cfg = resnet.ResNetConfig.tiny()
     rng = np.random.RandomState(0)
-    data = resnet.synthetic_batch(rng, args.samples, size=16)
+    test = None
+    if args.real_data:
+        # real handwritten digits (Alpaydin & Kaynak, bundled with
+        # scikit-learn — zero egress): 8x8 grayscale upsampled 2x and
+        # tiled to the model's 3-channel input, unit-normalized, with a
+        # held-out split for a REAL accuracy eval (reference parity:
+        # recognize_digits trains real MNIST)
+        from sklearn.datasets import load_digits
+
+        ds = load_digits()
+        x = (ds.images / 16.0).astype(np.float32)  # [N, 8, 8]
+        x = np.kron(x, np.ones((1, 2, 2), np.float32))  # -> [N, 16, 16]
+        x = np.repeat(x[..., None], 3, axis=-1)  # -> [N, 16, 16, 3]
+        y = ds.target.astype(np.int32)
+        order = rng.permutation(len(x))
+        n_test = len(x) // 10
+        ti, tr = order[:n_test], order[n_test:]
+        test = {"images": x[ti], "label": y[ti]}
+        data = {"images": x[tr], "label": y[tr]}
+        args.samples = len(tr)
+        print(f"real digits: {len(tr)} train / {n_test} held-out rows")
+    else:
+        data = resnet.synthetic_batch(rng, args.samples, size=16)
+    # every worker must own at least one chunk: shrink chunks if the
+    # dataset is small rather than dividing by an empty shard. Runs
+    # AFTER --real-data has replaced args.samples with the real row
+    # count — clamping against the pre-override value can still leave
+    # a worker with an empty shard.
+    args.chunk = min(args.chunk, max(args.samples // n_workers, 1))
     readers = [
         StaticShardReader(args.samples, args.chunk, n_workers, w)
         for w in range(n_workers)
@@ -103,20 +135,34 @@ def main() -> int:
         per_chip_batch=args.per_worker_batch,
     )
 
+    def test_accuracy():
+        if test is None:
+            return None
+        logits = resnet.forward(
+            runner.trainer.state.params, test["images"], cfg
+        )
+        return float(np.mean(np.argmax(np.asarray(logits), -1) == test["label"]))
+
     steps_per_epoch = max(args.samples // (args.per_worker_batch * n_workers), 1)
     ckpt_dir = tempfile.mkdtemp(prefix="digits_ckpt_")
     report = None
+    acc = None
     for epoch in range(args.epochs):
         report = runner.trainer.train_steps(data_fn, steps_per_epoch)
         # per-epoch checkpoint (reference: recognize_digits.py:84-88
         # save_inference_model each epoch)
         path = os.path.join(ckpt_dir, f"epoch_{epoch}")
         checkpoint.save(path, runner.trainer.state, {"epoch": epoch})
+        acc = test_accuracy()
         print(
             f"epoch {epoch}: loss {report.losses[-1]:.4f} "
-            f"(ckpt -> {path})"
+            + (f"test_acc {acc:.3f} " if acc is not None else "")
+            + f"(ckpt -> {path})"
         )
     runner.run(data_fn, n_steps=1)  # final step + mark complete
+    if acc is not None:
+        # real-data bar: clearly better than the 10-class chance floor
+        assert acc > 0.5, f"held-out accuracy {acc} barely above chance"
 
     assert ctl.phase_of(job.name) == JobPhase.SUCCEEDED
     assert report.losses[-1] < report.losses[0] * 1.05
