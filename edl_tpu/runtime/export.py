@@ -247,6 +247,23 @@ def _tree_insert(tree: Dict[str, Any], parts, leaf) -> None:
     node[parts[-1]] = leaf
 
 
+def _restore_lists(node):
+    """Flat leaf paths erase the dict-vs-list distinction (a list index
+    flattens to its decimal string): rebuild any {'0': .., '1': ..}
+    dense integer-keyed dict as the list the model structure actually
+    has (e.g. ctr's params['mlp'] layer stack) — a consumer's
+    ``for layer in params['mlp']`` must iterate layers, not key
+    strings."""
+    if isinstance(node, dict):
+        node = {k: _restore_lists(v) for k, v in node.items()}
+        # exact reconstruction test: the key set must be precisely
+        # {"0", ..., "n-1"} (canonical decimal — "00" or unicode digits
+        # are NOT list indices and must stay a dict)
+        if node and set(node) == {str(i) for i in range(len(node))}:
+            return [node[str(i)] for i in range(len(node))]
+    return node
+
+
 def _load_latest(root: str, build):
     """(build(doc), doc) against the latest pointer, retrying when the
     keep=2 GC deletes the pointed dir between the pointer read and the
@@ -306,7 +323,7 @@ def load_export_sharded(root: str, mesh, pspecs) -> Tuple[Any, Dict[str, Any]]:
             )
             _tree_insert(params, parts, garr)
             del arr  # one full leaf on host at a time
-        return params
+        return _restore_lists(params)
 
     return _load_latest(root, build)
 
@@ -321,6 +338,6 @@ def load_export(root: str) -> Tuple[Any, Dict[str, Any]]:
         params: Dict[str, Any] = {}
         for parts, arr in _iter_param_leaves(doc):
             _tree_insert(params, parts, arr)
-        return params
+        return _restore_lists(params)
 
     return _load_latest(root, build)

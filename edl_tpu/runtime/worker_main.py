@@ -267,6 +267,18 @@ def _ctr_workload(cfg: WorkerConfig) -> Workload:
         r = np.random.RandomState(cfg.seed * 1_000_003 + start + 1)
         return ctr.synthetic_batch(r, end - start, vocab=cfg.vocab)
 
+    def eval_auc(params, rows):
+        import jax.numpy as jnp
+
+        logits = ctr.forward(
+            params, jnp.asarray(rows["dense"]), jnp.asarray(rows["sparse"])
+        )
+        # the reference's in-train-loop metric (example/ctr/ctr/
+        # train.py:161-167): AUC over the held-out split
+        return float(
+            ctr.batch_auc(logits, jnp.asarray(rows["label"], jnp.float32))
+        )
+
     emb_kw = {"emb": cfg.emb} if cfg.emb else {}
     return Workload(
         lambda: ctr.init_params(
@@ -274,6 +286,7 @@ def _ctr_workload(cfg: WorkerConfig) -> Workload:
         ),
         ctr.make_loss_fn(),
         batch_fn,
+        eval_fn=eval_auc,
     )
 
 

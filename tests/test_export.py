@@ -141,3 +141,29 @@ def test_no_export_is_a_clean_miss(tmp_path):
     assert export_status(str(tmp_path)) is None
     with pytest.raises(FileNotFoundError):
         load_export(str(tmp_path))
+
+
+def test_export_restores_list_structured_params(tmp_path, cpu_devices):
+    """Flat leaf paths erase the list-vs-dict distinction; the loader
+    must rebuild integer-keyed levels as LISTS (ctr's params['mlp'] is
+    a layer list — `for layer in params['mlp']` must iterate layers,
+    not key strings)."""
+    import jax
+    import jax.numpy as jnp
+
+    from edl_tpu.models import ctr
+
+    params = ctr.init_params(jax.random.PRNGKey(0), vocab=512)
+    export_params(str(tmp_path), params, step=1, dtype="float32")
+    loaded, _ = load_export(str(tmp_path))
+    assert isinstance(loaded["mlp"], list) and len(loaded["mlp"]) == len(
+        params["mlp"]
+    )
+    rows = ctr.synthetic_batch(np.random.RandomState(0), 64, vocab=512)
+    want = ctr.forward(
+        params, jnp.asarray(rows["dense"]), jnp.asarray(rows["sparse"])
+    )
+    got = ctr.forward(
+        loaded, jnp.asarray(rows["dense"]), jnp.asarray(rows["sparse"])
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)

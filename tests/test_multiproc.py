@@ -512,10 +512,13 @@ def test_background_commits_under_rescale(tmp_path):
         model="linreg",
         min_workers=1,
         max_workers=3,
-        n_samples=4096,
+        n_samples=8192,
         passes=1,
         per_device_batch=32,
-        step_sleep_s=0.05,
+        # 0.1s/step x ~128 steps: the scale event lands well before the
+        # queue drains even when worker boot is slow under full-suite
+        # CPU contention (reshards==0 flake otherwise)
+        step_sleep_s=0.1,
         ckpt_every=1,
         work_dir=str(tmp_path),
     ) as launcher:
@@ -697,3 +700,43 @@ def test_migration_across_slices_via_p2p(tmp_path):
         assert stats["done"] == 768 // 16, stats
         assert stats["dead"] == 0 and stats["todo"] == 0
         assert float(launcher.kv("loss_last")) < float(launcher.kv("loss_first"))
+
+
+def test_ctr_job_publishes_auc_eval_metric(tmp_path):
+    """The CTR workload's in-job eval (the reference's AUC fetched in
+    the train loop, example/ctr/ctr/train.py:161-167): with a held-out
+    shard dir configured, the commit leader evaluates every published
+    export and the final eval_metric is a real AUC in (0, 1]."""
+    import numpy as np
+
+    from edl_tpu.models import ctr as ctr_model
+    from edl_tpu.runtime import shards
+
+    rng = np.random.RandomState(7)
+    eval_rows = ctr_model.synthetic_batch(rng, 512, vocab=1024)
+    eval_dir = str(tmp_path / "eval")
+    shards.write_shards(eval_dir, eval_rows, shard_size=512)
+
+    with ProcessJobLauncher(
+        job="mpauc",
+        model="ctr",
+        min_workers=2,
+        max_workers=2,
+        n_samples=2048,
+        passes=1,
+        per_device_batch=32,
+        ckpt_every=8,
+        export=True,
+        work_dir=str(tmp_path),
+        extra_env={"EDL_VOCAB": "1024", "EDL_EVAL_DIR": eval_dir},
+    ) as launcher:
+        launcher.start(2)
+        rcs = launcher.wait(timeout_s=240)
+        _assert_succeeded(launcher, rcs)
+        metric = launcher.kv("eval_metric")
+        assert metric is not None, "no eval_metric published"
+        step_s, auc_s = metric.split(":")
+        auc = float(auc_s)
+        assert 0.0 < auc <= 1.0 and int(step_s) > 0, metric
+        # the synthetic CTR click model is learnable: AUC beats coin-flip
+        assert auc > 0.55, metric
