@@ -290,6 +290,35 @@ def _ctr_workload(cfg: WorkerConfig) -> Workload:
     )
 
 
+_EVAL_CHUNK = 64  # rows per forward in held-out evals: LM heads emit
+# [rows, T, vocab] f32 logits — one unchunked call over a real split
+# would OOM the commit leader
+
+
+def _lm_ppl_eval(logits_fn):
+    """Chunked next-token perplexity over {tokens [N, T+1]} — shared by
+    the llama/moe workloads (only the forward differs); CE accumulates
+    per row slice so no [N, T, vocab] tensor ever materializes."""
+
+    def eval_ppl(params, rows):
+        import jax.numpy as jnp
+        import optax
+
+        toks = np.asarray(rows["tokens"])
+        total, count = 0.0, 0
+        for s in range(0, len(toks), _EVAL_CHUNK):
+            t = jnp.asarray(toks[s : s + _EVAL_CHUNK])
+            logits = logits_fn(params, t[:, :-1])
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                logits, t[:, 1:]
+            )
+            total += float(jnp.sum(ce))
+            count += ce.size
+        return float(np.exp(total / max(count, 1)))
+
+    return eval_ppl
+
+
 def _llama_workload(cfg: WorkerConfig) -> Workload:
     """The flagship: Llama decoder under elastic FSDP(×TP) — BASELINE
     config #5 ("Llama-3-8B elastic FSDP across growing TPU slice") at
@@ -313,6 +342,7 @@ def _llama_workload(cfg: WorkerConfig) -> Workload:
         # GPipe schedule) — rebuild the loss per rendezvous
         make_loss=lambda plan, mesh: llama.make_loss_fn(mcfg, plan, mesh),
         model_meta=mcfg.to_meta(),
+        eval_fn=_lm_ppl_eval(lambda p, t: llama.forward(p, t, mcfg)),
     )
 
 
@@ -329,12 +359,28 @@ def _bert_workload(cfg: WorkerConfig) -> Workload:
         r = np.random.RandomState(cfg.seed * 1_000_003 + start + 1)
         return bert.synthetic_mlm_batch(r, end - start, cfg.seq_len, cfg.vocab)
 
+    def eval_mlm_acc(params, rows):
+        import jax.numpy as jnp
+
+        # masked-token top-1 accuracy, chunked (vocab-sized logits)
+        correct = total = 0
+        toks = np.asarray(rows["tokens"])
+        for s in range(0, len(toks), _EVAL_CHUNK):
+            sl = slice(s, s + _EVAL_CHUNK)
+            logits = bert.forward(params, jnp.asarray(toks[sl]), mcfg)
+            pred = np.asarray(jnp.argmax(logits, -1))
+            mask = rows["mask"][sl] > 0
+            correct += int((pred[mask] == rows["targets"][sl][mask]).sum())
+            total += int(mask.sum())
+        return correct / max(total, 1)
+
     return Workload(
         lambda: bert.init_params(jax.random.PRNGKey(cfg.seed), mcfg),
         bert.make_loss_fn(mcfg),
         batch_fn,
         pspecs=lambda plan: bert.param_pspecs(mcfg, plan),
         model_meta=mcfg.to_meta(),
+        eval_fn=eval_mlm_acc,
     )
 
 
@@ -351,12 +397,20 @@ def _resnet_workload(cfg: WorkerConfig) -> Workload:
         r = np.random.RandomState(cfg.seed * 1_000_003 + start + 1)
         return resnet.synthetic_batch(r, end - start)
 
+    def eval_top1(params, rows):
+        import jax.numpy as jnp
+
+        logits = resnet.forward(params, jnp.asarray(rows["images"]), mcfg)
+        pred = np.asarray(jnp.argmax(logits, -1))
+        return float((pred == rows["label"]).mean())
+
     return Workload(
         lambda: resnet.init_params(jax.random.PRNGKey(cfg.seed), mcfg),
         resnet.make_loss_fn(mcfg),
         batch_fn,
         pspecs=lambda plan: resnet.param_pspecs(mcfg, plan),
         model_meta=mcfg.to_meta(),
+        eval_fn=eval_top1,
     )
 
 
@@ -380,6 +434,7 @@ def _moe_workload(cfg: WorkerConfig) -> Workload:
         batch_fn,
         pspecs=lambda plan: moe.param_pspecs(mcfg, plan),
         model_meta=mcfg.to_meta(),
+        eval_fn=_lm_ppl_eval(lambda p, t: moe.forward(p, t, mcfg)[0]),
     )
 
 

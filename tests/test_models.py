@@ -142,3 +142,26 @@ def test_resnet_fsdp_sharded_step(cpu_devices):
     )
     assert int(state.step) == 2
     assert np.isfinite(losses).all()
+
+
+def test_every_workload_defines_a_working_eval():
+    """Every model family's worker workload carries a held-out eval
+    hook (EDL_EVAL_DIR contract: linreg RMSE, ctr AUC, llama/moe
+    perplexity, bert masked accuracy, resnet top-1) that produces a
+    finite metric on its own batch format."""
+    import numpy as np
+
+    from edl_tpu.runtime.worker_main import WORKLOADS, WorkerConfig
+
+    for name, make in WORKLOADS.items():
+        cfg = WorkerConfig(
+            job="t", worker_id="w", coord_host="", coord_port=0,
+            min_workers=1, max_workers=1, fault_tolerant=False,
+            model=name, vocab=256, seq_len=16,
+        )
+        wl = make(cfg)
+        assert wl.eval_fn is not None, f"{name} has no eval_fn"
+        params = wl.init_params()
+        rows = wl.batch_fn(0, 32)
+        metric = wl.eval_fn(params, rows)
+        assert np.isfinite(metric), (name, metric)
