@@ -385,6 +385,57 @@ def run_export_status(args) -> int:
     return 0
 
 
+def run_job_status(args) -> int:
+    """Operator view into a RUNNING process-runtime job: the live
+    training metrics the workers publish in their job coordinator's KV
+    (progress, phase, loss curve endpoints, reshard count, held-out
+    eval_metric, last restore source, slice layout, queue accounting).
+    The reference's analog is watching the collector + kubectl logs;
+    here it is one command against the job coordinator."""
+    from edl_tpu.runtime.coordinator import CoordinatorClient
+
+    host, _, port = args.coordinator.rpartition(":")
+    try:
+        cl = CoordinatorClient(host or "127.0.0.1", int(port), 5.0,
+                               reconnect_window_s=0.0)
+    except (OSError, ValueError) as e:
+        print(f"cannot reach coordinator {args.coordinator}: {e}",
+              file=sys.stderr)
+        return 1
+    try:
+        k = lambda key: cl.kv_get(f"{args.job}/{key}")  # noqa: E731
+        members = cl.members()
+        rows = [
+            ("phase", k("phase") or "running"),
+            ("progress", k("progress") or "0"),
+            ("workers", ",".join(m.name for m in members) or "-"),
+            ("reshards", k("reshards") or "0"),
+            ("loss", f"{k('loss_first') or '?'} -> {k('loss_last') or '?'}"),
+            ("ckpt_step", k("ckpt_step") or "-"),
+            ("eval_metric", k("eval_metric") or "-"),
+            ("restore_last", k("restore_last") or "-"),
+            ("mesh_slices", k("mesh_slices") or "-"),
+        ]
+        # an uninitialized queue answers with zeros — there is no error
+        # arm to swallow here; a mid-read coordinator death raises and
+        # takes the clean error path below like every other round trip
+        q = cl.queue_stats()
+        rows.append((
+            "queue",
+            f"todo={q.get('todo')} leased={q.get('leased')} "
+            f"done={q.get('done')} dead={q.get('dead')}",
+        ))
+        for name, val in rows:
+            print(f"{name:14s} {val}")
+        return 0
+    except (ConnectionError, OSError, ValueError) as e:
+        # the coordinator died mid-read (reconnect window 0: fail fast)
+        print(f"coordinator failed mid-read: {e}", file=sys.stderr)
+        return 1
+    finally:
+        cl.close()
+
+
 def run_generate(args) -> int:
     """Decode from a published export — the serving consumer in one
     command (export manifest carries the architecture record; llama
@@ -614,6 +665,17 @@ def build_parser() -> argparse.ArgumentParser:
         "--fetch", default=None, help="copy the latest export to this dir"
     )
     ex.set_defaults(fn=run_export_status)
+
+    js = sub.add_parser(
+        "job-status",
+        help="live metrics of a running process-runtime job from its "
+        "coordinator KV (progress, eval_metric, reshards, slices, queue)",
+    )
+    js.add_argument("job", help="job name (the KV key prefix)")
+    js.add_argument(
+        "--coordinator", required=True, help="job coordinator host:port"
+    )
+    js.set_defaults(fn=run_job_status)
 
     g = sub.add_parser(
         "generate", help="decode tokens from a published llama export"

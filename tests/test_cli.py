@@ -210,3 +210,38 @@ def test_monitor_renders_host_fallbacks():
     )
     out = s.render()
     assert "reshards=3" in out and "host_fallbacks=1" in out
+
+
+def test_job_status_reads_live_coordinator(tmp_path, capsys):
+    """`edl job-status` — the operator's one-command view into a
+    running process-runtime job: live KV metrics + queue accounting
+    from the job coordinator."""
+    import pytest as _pytest
+
+    from edl_tpu.runtime.coordinator import (
+        CoordinatorServer,
+        ensure_native_built,
+    )
+
+    if not ensure_native_built():
+        _pytest.skip("no C++ toolchain")
+    with CoordinatorServer(member_ttl_s=5.0) as srv:
+        c = srv.client()
+        c.register("w000", 1)
+        c.kv_put("myjob/progress", "17")
+        c.kv_put("myjob/loss_first", "2.5")
+        c.kv_put("myjob/loss_last", "0.9")
+        c.kv_put("myjob/eval_metric", "16:0.87")
+        c.kv_put("myjob/restore_last", "p2p:12")
+        c.queue_init(128, 32, 1, 16.0)
+        assert main(["job-status", "myjob",
+                     "--coordinator", f"127.0.0.1:{srv.port}"]) == 0
+        out = capsys.readouterr().out
+        assert "progress" in out and "17" in out
+        assert "eval_metric" in out and "16:0.87" in out
+        assert "p2p:12" in out and "w000" in out
+        assert "todo=4" in out
+        c.close()
+    # unreachable coordinator is a clean error, not a traceback
+    assert main(["job-status", "x", "--coordinator", "127.0.0.1:1"]) == 1
+    assert "cannot reach" in capsys.readouterr().err
