@@ -195,7 +195,15 @@ class KubeApi:
         stream (watch window expired) — the caller re-watches from the
         last seen resourceVersion. Connection errors raise
         KubeApiError."""
-        params = {"watch": "true", "timeoutSeconds": str(max(1, int(timeout_s)))}
+        params = {
+            "watch": "true",
+            "timeoutSeconds": str(max(1, int(timeout_s))),
+            # without this a real API server never sends BOOKMARK
+            # events, so the resume-point advance during quiet periods
+            # (handled in the event loop) would only ever exercise
+            # against the test fake (ADVICE r4)
+            "allowWatchBookmarks": "true",
+        }
         if resource_version:
             params["resourceVersion"] = resource_version
         url, req = self._build_request("GET", path, params=params)

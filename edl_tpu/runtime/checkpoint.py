@@ -555,10 +555,16 @@ class _PieceIndex:
         ram: Optional[LocalSnapshot],
         remotes: Sequence[Any] = (),
     ):
-        # {leaf key: {offset: (shape, source)}} where source is a host
+        # {leaf key: {(offset, shape): source}} where source is a host
         # array or an (indexable, entry) lazy handle — NpzFile or a
-        # shard_server.RemotePieces, both fetched as src[entry]
-        self._index: Dict[str, Dict[Tuple[int, ...], Tuple[Tuple[int, ...], Any]]] = {}
+        # shard_server.RemotePieces, both fetched as src[entry]. Keyed
+        # by full (offset, extent) geometry so same-offset pieces of
+        # DIFFERENT extents (mixed world layouts in a P2P restore) both
+        # survive; replicas (same geometry) collapse, cheaper source
+        # winning by insertion order.
+        self._index: Dict[
+            str, Dict[Tuple[Tuple[int, ...], Tuple[int, ...]], Any]
+        ] = {}
         self._files: List[Any] = []
         if manifest is not None:
             for fname in manifest["files"]:
@@ -568,18 +574,17 @@ class _PieceIndex:
                 self._files.append(z)
                 for entry in z.files:
                     key, off, shape = _parse_piece_key(entry)
-                    self._index.setdefault(key, {})[off] = (shape, (z, entry))
+                    self._index.setdefault(key, {})[(off, shape)] = (z, entry)
         for src in remotes:
             for entry in src.entries():
                 key, off, shape = _parse_piece_key(entry)
-                self._index.setdefault(key, {})[off] = (shape, (src, entry))
+                self._index.setdefault(key, {})[(off, shape)] = (src, entry)
         if ram is not None:
             for key, plist in ram.pieces.items():
                 for off, arr in plist:
-                    self._index.setdefault(key, {})[off] = (
-                        tuple(arr.shape),
-                        arr,
-                    )
+                    self._index.setdefault(key, {})[
+                        (off, tuple(arr.shape))
+                    ] = arr
 
     def close(self) -> None:
         for z in self._files:
@@ -589,9 +594,11 @@ class _PieceIndex:
         self, key: str, idx: Tuple, shape: Tuple[int, ...], dtype
     ) -> np.ndarray:
         """Materialize the slice ``idx`` of leaf ``key`` from stored
-        pieces. Pieces share one disjoint tiling (all were cut by the
-        writing epoch's sharding), so clipped volumes summing to the
-        target volume proves full coverage."""
+        pieces. Coverage is proved geometrically (:func:`_boxes_tile`
+        over the clipped piece boxes), so overlapping pieces from mixed
+        world layouts (P2P restores) are handled correctly — overlap
+        regions carry identical same-step bytes, and a genuine hole is
+        surfaced even when clipped volumes sum past the target."""
         starts = [
             (s.start or 0) if isinstance(s, slice) else 0 for s in idx
         ]
@@ -603,11 +610,11 @@ class _PieceIndex:
         ]
         out_shape = tuple(e - b for b, e in zip(starts, stops))
         out = np.empty(out_shape, dtype)
-        covered = 0
-        for off, (pshape, src) in self._index.get(key, {}).items():
+        boxes: List[Tuple[Tuple[int, ...], Tuple[int, ...]]] = []
+        for (off, pshape), src in self._index.get(key, {}).items():
             if not shape:  # scalar leaf
                 out[...] = src if isinstance(src, np.ndarray) else src[0][src[1]]
-                covered = 1
+                boxes.append(((), ()))
                 break
             lo = [max(b, o) for b, o in zip(starts, off)]
             hi = [min(e, o + s) for e, o, s in zip(stops, off, pshape)]
@@ -619,12 +626,14 @@ class _PieceIndex:
             ] = arr[
                 tuple(slice(l - o, h - o) for l, o, h in zip(lo, off, hi))
             ]
-            covered += int(np.prod([h - l for l, h in zip(lo, hi)]))
-        total = int(np.prod(out_shape)) if out_shape else 1
-        if covered < total:
+            boxes.append((
+                tuple(l - b for l, b in zip(lo, starts)),
+                tuple(h - l for l, h in zip(lo, hi)),
+            ))
+        if not _boxes_tile(out_shape, boxes):
             raise ValueError(
                 f"checkpoint piece coverage incomplete for {key}{idx}: "
-                f"{covered}/{total} elements"
+                f"{len(boxes)} pieces leave a hole in {out_shape}"
             )
         return out
 
@@ -728,25 +737,64 @@ def template_schema(like: TrainState) -> Tuple[Dict[str, Tuple[int, ...]], Dict[
     return shapes, dtypes
 
 
+def _boxes_tile(shape: Tuple[int, ...], boxes: Sequence[Tuple[Tuple[int, ...], Tuple[int, ...]]]) -> bool:
+    """Whether axis-aligned boxes ``(offset, extent)`` cover every element
+    of ``shape`` — a true geometric union, not an element-count sum, so
+    partially overlapping pieces at misaligned offsets (e.g. same-step
+    snapshots taken under two different world layouts) cannot sum past
+    the total while leaving a hole. Coordinate-compress each axis on the
+    box boundaries, then mark covered cells on a boolean grid: correct
+    for any overlap pattern, and cheap for real shard layouts (pieces
+    cut along at most a couple of axes, so the grid stays tiny)."""
+    if not shape:
+        return bool(boxes)
+    if any(s == 0 for s in shape):
+        return True
+    cuts: List[List[int]] = []
+    for d, size in enumerate(shape):
+        c = {0, size}
+        for off, ext in boxes:
+            c.add(min(max(off[d], 0), size))
+            c.add(min(max(off[d] + ext[d], 0), size))
+        cuts.append(sorted(c))
+    grid_shape = tuple(len(c) - 1 for c in cuts)
+    if int(np.prod(grid_shape)) > (1 << 24):  # pathological offsets only:
+        # fall back to the conservative answer — an uncommitted P2P
+        # restore degrades to the disk manifest, never to a hole.
+        return False
+    grid = np.zeros(grid_shape, dtype=bool)
+    for off, ext in boxes:
+        sel = tuple(
+            slice(
+                int(np.searchsorted(cuts[d], min(max(off[d], 0), size))),
+                int(np.searchsorted(cuts[d], min(max(off[d] + ext[d], 0), size))),
+            )
+            for d, size in enumerate(shape)
+        )
+        grid[sel] = True
+    return bool(grid.all())
+
+
 def peer_coverage_ok(
     like: TrainState, piece_entries: Sequence[str]
 ) -> bool:
     """Whether a set of piece entry keys (from peers' shard-server
     indexes, deduped by (leaf, offset) — replicas collapse) tiles every
     leaf of ``like`` completely. Pure key geometry, no byte transfer:
-    the go/no-go check before committing a membership to a P2P restore."""
+    the go/no-go check before committing a membership to a P2P restore.
+    Coverage is decided by per-leaf box union (:func:`_boxes_tile`), so
+    the decision agrees with what assembly will actually find."""
     shapes, _ = template_schema(like)
-    have: Dict[str, int] = {}
+    boxes: Dict[str, List[Tuple[Tuple[int, ...], Tuple[int, ...]]]] = {}
     seen = set()
     for entry in piece_entries:
         key, off, shape = _parse_piece_key(entry)
-        if (key, off) in seen:
+        if (key, off, shape) in seen:
             continue
-        seen.add((key, off))
-        have[key] = have.get(key, 0) + (int(np.prod(shape)) if shape else 1)
+        seen.add((key, off, shape))
+        boxes.setdefault(key, []).append((off, shape))
     for key, shape in shapes.items():
-        total = int(np.prod(shape)) if shape else 1
-        if have.get(key, 0) < total:
+        if not _boxes_tile(tuple(shape), boxes.get(key, ())):
             return False
     return True
 

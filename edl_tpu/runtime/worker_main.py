@@ -820,10 +820,26 @@ class ElasticWorker:
                 | ({own.step} if own is not None else set()),
                 reverse=True,
             )
+            # a worker that failed ASSEMBLING a p2p step (peer advertised
+            # pieces but fetches failed) vetoes that step for a few
+            # epochs — otherwise a deterministic decision re-picks the
+            # doomed step every regroup until the failure abort, even
+            # though the manifest fallback was available (ADVICE r4)
+            veto_step = -1
+            raw_veto = cl.kv_get(self._k("p2p_veto"))
+            if raw_veto:
+                try:
+                    vs, ve = raw_veto.split(":")
+                    if epoch - int(ve) <= 4:
+                        veto_step = int(vs)
+                except ValueError:
+                    pass
             decision = "none"
             for s in cand:
                 if s < m_step:
                     break  # never restore older than the committed truth
+                if s == veto_step:
+                    continue
                 entries = [
                     e
                     for (_, ps, es) in peers.values()
@@ -888,6 +904,15 @@ class ElasticWorker:
                 manifest=manifest,
                 remotes=remotes,
             )
+        except Exception:
+            # veto this step so the regroup's next decision falls
+            # through to the manifest instead of re-picking it (the
+            # veto key is NOT epoch-scoped: it must outlive this epoch)
+            try:
+                cl.kv_put(self._k("p2p_veto"), f"{step}:{epoch}")
+            except Exception:
+                pass
+            raise
         finally:
             for r in remotes:
                 r.close()

@@ -213,6 +213,10 @@ class FakeKubeServer:
                 (the real API-server contract the client resumes on)."""
                 since = int(params.get("resourceVersion") or 0)
                 timeout = float(params.get("timeoutSeconds") or 30)
+                # real API servers only send BOOKMARK when the client
+                # opted in — mirror that so a client that forgets the
+                # param fails the quiet-period resume tests
+                bookmarks_on = params.get("allowWatchBookmarks") == "true"
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Connection", "close")
@@ -264,7 +268,7 @@ class FakeKubeServer:
                         # quiet periods and through events of OTHER
                         # routes, so a reconnect doesn't start from a
                         # compactable rv
-                        if time.monotonic() - last_bookmark > 0.2:
+                        if bookmarks_on and time.monotonic() - last_bookmark > 0.2:
                             if head > sent:
                                 line = json.dumps({
                                     "type": "BOOKMARK",

@@ -94,6 +94,85 @@ def test_peer_coverage_geometry():
     # a missing tile fails the check
     assert not ckpt.peer_coverage_ok(like, full[1:])
 
+    opt_full = [_piece_key(k, (0, 0), (4, 4)) for k in opt_keys]
+    # OVERLAPPING pieces at misaligned offsets (same-step snapshots from
+    # two different world layouts): rows 0-2 and rows 1-3 overlap on
+    # rows 1-2 and sum to 24 >= 16 elements while leaving row 3 bare —
+    # an element-count check would wrongly pass this
+    holey = [
+        _piece_key("p:w", (0, 0), (3, 4)),
+        _piece_key("p:w", (1, 0), (2, 4)),
+    ] + opt_full
+    assert not ckpt.peer_coverage_ok(like, holey)
+    # ... while a misaligned overlap whose union truly tiles passes
+    tiled = [
+        _piece_key("p:w", (0, 0), (3, 4)),
+        _piece_key("p:w", (1, 0), (3, 4)),
+    ] + opt_full
+    assert ckpt.peer_coverage_ok(like, tiled)
+    # mixed-axis layouts: row-cut ∪ column-cut with one column piece
+    # missing covers >16 elements but not column 2-3 of rows 2-3
+    cross_hole = [
+        _piece_key("p:w", (0, 0), (2, 4)),
+        _piece_key("p:w", (0, 0), (4, 2)),
+    ] + opt_full
+    assert not ckpt.peer_coverage_ok(like, cross_hole)
+    assert ckpt.peer_coverage_ok(
+        like,
+        cross_hole + [_piece_key("p:w", (2, 2), (2, 2))],
+    )
+
+
+def test_boxes_tile_unit():
+    """Direct geometry unit: _boxes_tile is a true box union."""
+    assert ckpt._boxes_tile((4,), [((0,), (2,)), ((2,), (2,))])
+    assert not ckpt._boxes_tile((4,), [((0,), (2,)), ((3,), (1,))])
+    # overlap does not double-count
+    assert not ckpt._boxes_tile((4,), [((0,), (3,)), ((1,), (2,))])
+    assert ckpt._boxes_tile((4,), [((0,), (3,)), ((1,), (3,))])
+    # scalar leaves: any piece covers, none does not
+    assert ckpt._boxes_tile((), [((), ())])
+    assert not ckpt._boxes_tile((), [])
+    # 3-d cross-cut hole
+    assert not ckpt._boxes_tile(
+        (2, 2, 2),
+        [((0, 0, 0), (1, 2, 2)), ((0, 0, 0), (2, 2, 1)), ((1, 0, 1), (1, 1, 1))],
+    )
+    assert ckpt._boxes_tile(
+        (2, 2, 2),
+        [
+            ((0, 0, 0), (1, 2, 2)),
+            ((0, 0, 0), (2, 2, 1)),
+            ((1, 0, 1), (1, 1, 1)),
+            ((1, 1, 1), (1, 1, 1)),
+        ],
+    )
+
+
+def test_assemble_rejects_overlap_hole():
+    """The assemble-time check agrees with the decision check: pieces
+    that overlap their way past the element total still raise on the
+    genuine hole instead of returning uninitialized memory."""
+    a = np.arange(12, dtype=np.float32).reshape(3, 4)
+    snap = _snap(
+        3,
+        {
+            "p:w": [
+                ((0, 0), a),                      # rows 0-2
+                ((1, 0), np.arange(4, 16, dtype=np.float32).reshape(3, 4)),  # rows 1-3
+            ]
+        },
+    )
+    # leaf is 5 rows total; rows 0-3 covered, row 4 is a hole although
+    # 12 + 12 = 24 > 20 elements
+    idx = ckpt._PieceIndex(None, snap)
+    with pytest.raises(ValueError, match="hole|coverage"):
+        idx.assemble("p:w", (slice(0, 5), slice(0, 4)), (5, 4), np.float32)
+    # the covered sub-slice still assembles fine, overlap bytes agree
+    got = idx.assemble("p:w", (slice(0, 4), slice(0, 4)), (5, 4), np.float32)
+    np.testing.assert_array_equal(got[:3], a)
+    assert got.shape == (4, 4)
+
 
 def test_pure_peer_restore_reassembles_state(cpu_devices):
     """load_from_pieces with ONLY remote sources (no manifest, no local
