@@ -205,48 +205,152 @@ def _llama_flagship_bench(n_dev, plan, mesh, rng) -> dict:
     }
 
 
-def _p2p_bench() -> dict:
-    """Shard-plane throughput: serve a ~128 MB host-RAM snapshot
-    through runtime/shard_server.py and fetch every piece back — the
-    transfer rate of a P2P migration reshard (host-RAM → TCP →
-    host-RAM; loopback here, DCN between hosts in production). Powers
-    p2p_migrate_stall_model in doc/reshard_stall.md."""
-    from edl_tpu.runtime import checkpoint as ck
-    from edl_tpu.runtime.checkpoint import LocalSnapshot
-    from edl_tpu.runtime.shard_server import (
-        RemotePieces,
-        ShardServer,
-        fetch_index,
-    )
+_P2P_SERVER_SRC = """
+import sys, time
+import numpy as np
+from edl_tpu.runtime.checkpoint import LocalSnapshot
+from edl_tpu.runtime.shard_server import ShardServer
 
-    n_pieces, rows = 8, 4096
-    piece = np.random.RandomState(0).rand(rows, 1024).astype(np.float32)
-    pieces = {
-        "p:w": [((i * rows, 0), piece) for i in range(n_pieces)]
-    }
-    snap = LocalSnapshot(
-        step=1,
-        pieces=pieces,
-        primary={"p:w": [o for o, _ in pieces["p:w"]]},
-        shapes={"p:w": (n_pieces * rows, 1024)},
-        dtypes={"p:w": "float32"},
-    )
-    srv = ShardServer(lambda: snap)
+seed, n_pieces, rows = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+piece = np.random.RandomState(seed).rand(rows, 1024).astype(np.float32)
+pieces = {"p:w": [((i * rows, 0), piece) for i in range(n_pieces)]}
+snap = LocalSnapshot(
+    step=1, pieces=pieces,
+    primary={"p:w": [o for o, _ in pieces["p:w"]]},
+    shapes={"p:w": (n_pieces * rows, 1024)}, dtypes={"p:w": "float32"},
+)
+srv = ShardServer(lambda: snap)
+print(srv.port, flush=True)
+time.sleep(120)
+"""
+
+_P2P_FETCHER_SRC = """
+import sys, time
+from edl_tpu.runtime.shard_server import RemotePieces, fetch_index
+
+ports = [int(p) for p in sys.argv[1].split(",")]
+reps = int(sys.argv[2])
+best = float("inf")
+for _ in range(reps):
+    t0 = time.perf_counter()
+    total = 0
+    for port in ports:
+        _, entries = fetch_index(f"127.0.0.1:{port}")
+        rp = RemotePieces(f"127.0.0.1:{port}", entries)
+        got = rp.get_many(list(entries))
+        total += sum(a.nbytes for a in got.values())
+        rp.close()
+    best = min(best, time.perf_counter() - t0)
+print(total, best, flush=True)
+"""
+
+
+def _p2p_env() -> dict:
+    import os
+
+    # the helper processes only move host bytes — keep them off the
+    # TPU tunnel entirely
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    return env
+
+
+def _p2p_spawn_servers(n: int, n_pieces: int, rows: int):
+    import subprocess
+    import sys as _sys
+
+    procs, ports = [], []
     try:
-        _, entries = fetch_index(f"127.0.0.1:{srv.port}")
-        rp = RemotePieces(f"127.0.0.1:{srv.port}", entries)
+        for i in range(n):
+            p = subprocess.Popen(
+                [
+                    _sys.executable, "-c", _P2P_SERVER_SRC,
+                    str(i), str(n_pieces), str(rows),
+                ],
+                stdout=subprocess.PIPE, env=_p2p_env(), text=True,
+            )
+            procs.append(p)
+        for p in procs:
+            ports.append(int(p.stdout.readline()))
+    except Exception:
+        # a server that died before printing its port must not leave
+        # the others sleeping with ~0.5 GB resident each
+        for p in procs:
+            p.kill()
+        raise
+    return procs, ports
+
+
+def _p2p_bench() -> dict:
+    """Shard-plane throughput, measured in the production topology —
+    the serving worker is a SEPARATE PROCESS (an in-process loopback
+    measurement shares one GIL between both ends and understates the
+    plane ~2x). Two numbers (VERDICT r4 #1):
+
+    - ``p2p_bw_gbs``: one fetcher draining one peer's ~128 MB snapshot
+      through the pooled pipelined FETCHN path — the single-link rate
+      the migration stall model uses;
+    - ``p2p_agg_bw_gbs``: 4 fetcher processes × 4 server processes
+      (every fetcher drains every server — the all-to-all shape of a
+      real mesh migration restore), aggregate bytes over the slowest
+      fetcher's wall clock. This is what a v5e-pod restore scales by.
+    """
+    import subprocess
+    import sys as _sys
+
+    from edl_tpu.runtime import checkpoint as ck
+    from edl_tpu.runtime.shard_server import RemotePieces, fetch_index
+
+    # --- single peer, one fetcher (this process) ---
+    # ~512 MB snapshot: a migration moves GBs per host, so the bench
+    # payload must amortize the one-shot costs a real restore amortizes
+    # (connects, buffer autotuning, first-touch page faults) — 128 MB
+    # under-reports the plane ~2x
+    procs, ports = _p2p_spawn_servers(1, n_pieces=16, rows=8192)
+    try:
+        _, entries = fetch_index(f"127.0.0.1:{ports[0]}")
         total = 0
         best = float("inf")
-        for _ in range(2):
+        for _ in range(3):
+            rp = RemotePieces(f"127.0.0.1:{ports[0]}", entries)
             t0 = time.perf_counter()
-            total = sum(rp[e].nbytes for e in entries)
+            got = rp.get_many(list(entries))
             best = min(best, time.perf_counter() - t0)
-        rp.close()
+            total = sum(a.nbytes for a in got.values())
+            rp.close()
     finally:
-        srv.close()
+        for p in procs:
+            p.kill()
     bw = total / best
+
+    # --- aggregate: 4 fetcher procs x 4 server procs, all-to-all ---
+    n_srv, n_fetch = 4, 4
+    procs, ports = _p2p_spawn_servers(n_srv, n_pieces=4, rows=8192)
+    try:
+        port_arg = ",".join(str(p) for p in ports)
+        fetchers = [
+            subprocess.Popen(
+                [_sys.executable, "-c", _P2P_FETCHER_SRC, port_arg, "2"],
+                stdout=subprocess.PIPE, env=_p2p_env(), text=True,
+            )
+            for _ in range(n_fetch)
+        ]
+        agg_bytes = 0
+        worst = 0.0
+        for f in fetchers:
+            out = f.stdout.readline().split()
+            agg_bytes += int(out[0])
+            worst = max(worst, float(out[1]))
+            f.wait(timeout=30)
+    finally:
+        for p in procs:
+            p.kill()
+    agg_bw = agg_bytes / worst if worst else 0.0
+
     return {
         "p2p_bw_gbs": round(bw / (1 << 30), 3),
+        "p2p_agg_bw_gbs": round(agg_bw / (1 << 30), 3),
         "stall_model_8b_migrate_s": round(
             ck.p2p_migrate_stall_model(17 * (1 << 30), 1, bw), 1
         ),

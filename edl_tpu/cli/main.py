@@ -526,6 +526,61 @@ def run_generate(args) -> int:
     return 0
 
 
+def run_predict(args) -> int:
+    """Score a batch of rows against a published export — the serving
+    consumer for EVERY family (the reference's serving artifact is
+    precisely this offline scorer over the CTR inference model,
+    /root/reference/example/ctr/ctr/train.py:169-180). Family dispatch,
+    input decoding, chunked forwards, and sharded loading all live in
+    runtime/predict.py; this verb is arg plumbing. Imports jax lazily
+    via that module: control-plane verbs stay device-free."""
+    import numpy as np
+
+    from edl_tpu.runtime.predict import (
+        load_params_for_predict,
+        load_rows,
+        predict_batch,
+    )
+
+    try:
+        rows = load_rows(args.input, args.data_dir, n_rows=args.rows)
+    except (ValueError, FileNotFoundError) as e:
+        print(f"bad input: {e}", file=sys.stderr)
+        return 1
+    try:
+        params, doc = load_params_for_predict(
+            args.export_dir, args.mesh or None
+        )
+    except FileNotFoundError as e:
+        print(str(e), file=sys.stderr)
+        return 1
+    except ValueError as e:
+        print(f"bad --mesh {args.mesh!r}: {e}", file=sys.stderr)
+        return 1
+    try:
+        out = predict_batch(params, doc, rows)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 1
+    family = (doc.get("model") or {}).get("family")
+    arrays = {k: v for k, v in out.items() if isinstance(v, np.ndarray)}
+    metrics = {k: v for k, v in out.items() if not isinstance(v, np.ndarray)}
+    n = len(next(iter(arrays.values()))) if arrays else 0
+    summary = " ".join(f"{k}={v:.6g}" for k, v in sorted(metrics.items()))
+    print(
+        f"predicted {n} rows (family={family}, step={doc['step']})"
+        + (f" {summary}" if summary else "")
+    )
+    if args.out:
+        np.savez(args.out, **arrays)
+        print(f"outputs -> {args.out}")
+    else:
+        for k, v in sorted(arrays.items()):
+            head = np.asarray(v).reshape(len(v), -1)[:8, 0]
+            print(f"{k}[:8] = {head.tolist()}")
+    return 0
+
+
 def run_validate(args) -> int:
     try:
         job = TrainingJob.from_yaml_file(args.manifest)
@@ -695,6 +750,37 @@ def build_parser() -> argparse.ArgumentParser:
         "bigger than one chip's HBM serve at all",
     )
     g.set_defaults(fn=run_generate)
+
+    pr = sub.add_parser(
+        "predict",
+        help="score a batch of rows against a published export "
+        "(any family: ctr/resnet/bert/llama/moe)",
+    )
+    pr.add_argument("export_dir")
+    pr.add_argument(
+        "--input", default=None,
+        help=".npz of input rows (family keys: ctr dense/sparse[/label], "
+        "resnet images[/label], bert/llama/moe tokens)",
+    )
+    pr.add_argument(
+        "--data-dir", default=None,
+        help="score the head of a shards-dir dataset instead of --input",
+    )
+    pr.add_argument(
+        "--rows", type=int, default=256,
+        help="row count when reading --data-dir",
+    )
+    pr.add_argument(
+        "--out", default=None,
+        help="write per-row outputs to this .npz (default: summary only)",
+    )
+    pr.add_argument(
+        "--mesh", default="",
+        help='serve sharded: MeshPlan grammar (e.g. "fsdp=4") — any '
+        "family's export loads onto the mesh via the generic training "
+        "pspec rule",
+    )
+    pr.set_defaults(fn=run_predict)
 
     return p
 

@@ -44,6 +44,12 @@ class BertConfig:
 
         return dataclass_meta(self, "bert")
 
+    @classmethod
+    def from_meta(cls, meta: dict) -> "BertConfig":
+        from edl_tpu.models.meta import dataclass_from_meta
+
+        return dataclass_from_meta(cls, meta, "bert")
+
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads

@@ -83,7 +83,9 @@ def make_loss_fn(compute_dtype=jnp.float32):
         logits = forward(
             params, batch["dense"].astype(compute_dtype), batch["sparse"]
         ).astype(jnp.float32)
-        labels = batch["label"].astype(jnp.float32)
+        # reshape, not broadcast: a [N, 1] label column against [N]
+        # logits would silently blow per_row up to [N, N]
+        labels = batch["label"].astype(jnp.float32).reshape(logits.shape)
         from edl_tpu.models.losses import row_mean
 
         per_row = (
@@ -103,7 +105,14 @@ loss_fn = make_loss_fn()
 
 def batch_auc(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
     """Batch AUC via the rank statistic (reference tracks batch_auc_var,
-    train.py:120-176)."""
+    train.py:120-176). Labels are flattened to [N]: a [N, 1] column
+    (how tabular pipelines often store targets) would silently
+    broadcast the rank sum to [N, N] and report nonsense > 1."""
+    labels = labels.reshape(-1)
+    if labels.shape[0] != logits.shape[0]:
+        raise ValueError(
+            f"labels {labels.shape} do not match logits {logits.shape}"
+        )
     order = jnp.argsort(logits)
     ranks = jnp.empty_like(order).at[order].set(jnp.arange(logits.shape[0]))
     pos = labels > 0.5

@@ -18,5 +18,29 @@ def dataclass_meta(cfg: Any, family: str) -> Dict[str, Any]:
         v = getattr(cfg, f.name)
         if f.name == "dtype":
             v = jnp.dtype(v).name
+        elif isinstance(v, tuple):
+            v = list(v)  # JSON round-trip safe
         out[f.name] = v
     return out
+
+
+def dataclass_from_meta(cls, meta: Dict[str, Any], family: str):
+    """Rebuild a config dataclass from its export architecture record —
+    the inverse of :func:`dataclass_meta` (serving consumers:
+    runtime/predict.py). Unknown keys are ignored (forward compat);
+    a family mismatch is a hard error so a consumer can never run the
+    wrong forward over an export's weights."""
+    got = meta.get("family")
+    if got != family:
+        raise ValueError(f"not a {family} export: family={got!r}")
+    kwargs: Dict[str, Any] = {}
+    for f in dataclasses.fields(cls):
+        if f.name not in meta:
+            continue
+        v = meta[f.name]
+        if f.name == "dtype":
+            v = jnp.dtype(v)
+        elif isinstance(v, list):
+            v = tuple(v)
+        kwargs[f.name] = v
+    return cls(**kwargs)

@@ -57,6 +57,12 @@ class MoEConfig:
 
         return dataclass_meta(self, "moe")
 
+    @classmethod
+    def from_meta(cls, meta: dict) -> "MoEConfig":
+        from edl_tpu.models.meta import dataclass_from_meta
+
+        return dataclass_from_meta(cls, meta, "moe")
+
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads

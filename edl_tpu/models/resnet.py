@@ -39,6 +39,12 @@ class ResNetConfig:
         return dataclass_meta(self, "resnet")
 
     @classmethod
+    def from_meta(cls, meta: dict) -> "ResNetConfig":
+        from edl_tpu.models.meta import dataclass_from_meta
+
+        return dataclass_from_meta(cls, meta, "resnet")
+
+    @classmethod
     def resnet50(cls) -> "ResNetConfig":
         return cls()
 

@@ -28,6 +28,7 @@ from __future__ import annotations
 import json
 import os
 import tempfile
+import threading
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -554,7 +555,26 @@ class _PieceIndex:
         manifest: Optional[Dict[str, Any]],
         ram: Optional[LocalSnapshot],
         remotes: Sequence[Any] = (),
+        shapes: Optional[Dict[str, Tuple[int, ...]]] = None,
     ):
+        # expected leaf ranks (when known): geometry of a different rank
+        # — a stale/version-skewed peer — is dropped HERE, so a skewed
+        # entry can neither crash assemble's box math nor be silently
+        # zip-truncated into the overlap test; the same filter
+        # peer_coverage_ok applies at decision time
+        ranks = (
+            {k: len(tuple(s)) for k, s in shapes.items()}
+            if shapes is not None
+            else None
+        )
+
+        def ok(key: str, off, shape) -> bool:
+            return (
+                ranks is None
+                or key not in ranks
+                or (len(off) == ranks[key] and len(shape) == ranks[key])
+            )
+
         # {leaf key: {(offset, shape): source}} where source is a host
         # array or an (indexable, entry) lazy handle — NpzFile or a
         # shard_server.RemotePieces, both fetched as src[entry]. Keyed
@@ -574,11 +594,13 @@ class _PieceIndex:
                 self._files.append(z)
                 for entry in z.files:
                     key, off, shape = _parse_piece_key(entry)
-                    self._index.setdefault(key, {})[(off, shape)] = (z, entry)
+                    if ok(key, off, shape):
+                        self._index.setdefault(key, {})[(off, shape)] = (z, entry)
         for src in remotes:
             for entry in src.entries():
                 key, off, shape = _parse_piece_key(entry)
-                self._index.setdefault(key, {})[(off, shape)] = (src, entry)
+                if ok(key, off, shape):
+                    self._index.setdefault(key, {})[(off, shape)] = (src, entry)
         if ram is not None:
             for key, plist in ram.pieces.items():
                 for off, arr in plist:
@@ -589,6 +611,63 @@ class _PieceIndex:
     def close(self) -> None:
         for z in self._files:
             z.close()
+
+    def prefetch(self, wants: Sequence[Tuple[str, Tuple[int, ...], Tuple[int, ...]]]) -> None:
+        """Batch-fetch every REMOTE piece overlapping the wanted boxes
+        (``(leaf key, starts, stops)``) before assembly: entries are
+        grouped per peer and drained through each peer's ``get_many``
+        (parallel pooled connections), with the peers themselves
+        drained concurrently — so a restore moves at aggregate network
+        speed instead of one piece per RTT. Purely an optimization:
+        pieces it misses are fetched lazily by ``assemble``."""
+        by_src: Dict[int, Tuple[Any, set]] = {}
+        for key, starts, stops in wants:
+            for (off, pshape), src in self._index.get(key, {}).items():
+                if isinstance(src, np.ndarray) or not isinstance(src, tuple):
+                    continue
+                holder, entry = src
+                if not hasattr(holder, "get_many"):
+                    continue
+                if pshape and starts:
+                    lo = [max(b, o) for b, o in zip(starts, off)]
+                    hi = [
+                        min(e, o + s)
+                        for e, o, s in zip(stops, off, pshape)
+                    ]
+                    if any(l >= h for l, h in zip(lo, hi)):
+                        continue
+                by_src.setdefault(id(holder), (holder, set()))[1].add(entry)
+        if not by_src:
+            return
+        errs: List[BaseException] = []
+
+        def drain(holder, entries) -> None:
+            try:
+                holder.get_many(sorted(entries))
+            except BaseException as e:
+                # a dead peer surfaces at assembly (coverage check), not
+                # here — prefetch must not turn a survivable layout into
+                # a hard failure
+                errs.append(e)
+
+        if len(by_src) == 1:
+            ((holder, entries),) = by_src.values()
+            drain(holder, entries)
+        else:
+            threads = [
+                threading.Thread(target=drain, args=(h, es), daemon=True)
+                for h, es in by_src.values()
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        if errs:
+            from edl_tpu.utils.logging import kv_logger
+
+            kv_logger("checkpoint").warn(
+                "p2p prefetch incomplete", err=str(errs[0])
+            )
 
     def assemble(
         self, key: str, idx: Tuple, shape: Tuple[int, ...], dtype
@@ -646,6 +725,44 @@ def _materialize(
     shapes: Dict[str, Tuple[int, ...]],
     dtypes: Dict[str, str],
 ) -> TrainState:
+    def _wants(prefix: str, tmpl, shardings):
+        """Every (leaf, starts, stops) box this process's devices will
+        assemble — known up front from the target sharding, so remote
+        pieces can be prefetched in one parallel pass across peers
+        instead of one lazy fetch per piece during assembly."""
+        keys = [k for k, _ in _leaf_keys(tmpl)]
+        leaves = jax.tree_util.tree_leaves(tmpl)
+        sh_leaves = jax.tree_util.tree_leaves(
+            shardings, is_leaf=lambda x: hasattr(x, "device_set")
+        )
+        out = []
+        for key, leaf, sh in zip(keys, leaves, sh_leaves):
+            shape = tuple(getattr(leaf, "shape", ()))
+            try:
+                idxs = set(
+                    sh.addressable_devices_indices_map(shape).values()
+                )
+            except Exception:
+                continue  # unknown sharding flavor: lazy fetches cover it
+            for idx in idxs:
+                starts = tuple(
+                    (s.start or 0) if isinstance(s, slice) else 0
+                    for s in idx
+                )
+                stops = tuple(
+                    (s.stop if s.stop is not None else shape[i])
+                    if isinstance(s, slice)
+                    else shape[i]
+                    for i, s in enumerate(idx)
+                )
+                out.append((f"{prefix}:{key}", starts, stops))
+        return out
+
+    index.prefetch(
+        _wants("p", like.params, state_shardings.params)
+        + _wants("o", like.opt_state, state_shardings.opt_state)
+    )
+
     def _build(prefix: str, tmpl, shardings):
         keys = [k for k, _ in _leaf_keys(tmpl)]
         leaves = jax.tree_util.tree_leaves(tmpl)
@@ -711,14 +828,15 @@ def load_sharded(
         raise FileNotFoundError(f"no committed sharded checkpoint under {root}")
     if ram is not None and ram.step != manifest["step"]:
         ram = None  # stale/ahead RAM: disk manifest is the agreed truth
-    index = _PieceIndex(manifest, ram)
+    shapes = {k: tuple(v) for k, v in manifest["shapes"].items()}
+    index = _PieceIndex(manifest, ram, shapes=shapes)
     try:
         return _materialize(
             index,
             manifest["step"],
             like,
             state_shardings,
-            {k: tuple(v) for k, v in manifest["shapes"].items()},
+            shapes,
             manifest["dtypes"],
         )
     finally:
@@ -780,10 +898,13 @@ def peer_coverage_ok(
 ) -> bool:
     """Whether a set of piece entry keys (from peers' shard-server
     indexes, deduped by (leaf, offset) — replicas collapse) tiles every
-    leaf of ``like`` completely. Pure key geometry, no byte transfer:
-    the go/no-go check before committing a membership to a P2P restore.
-    Coverage is decided by per-leaf box union (:func:`_boxes_tile`), so
-    the decision agrees with what assembly will actually find."""
+    leaf of ``like`` completely — deduped by full (leaf, offset, extent)
+    geometry, so replicas collapse while same-offset pieces of DIFFERENT
+    extents (mixed world layouts) all contribute. Pure key geometry, no
+    byte transfer: the go/no-go check before committing a membership to
+    a P2P restore. Coverage is decided by per-leaf box union
+    (:func:`_boxes_tile`), so the decision agrees with what assembly
+    will actually find."""
     shapes, _ = template_schema(like)
     boxes: Dict[str, List[Tuple[Tuple[int, ...], Tuple[int, ...]]]] = {}
     seen = set()
@@ -794,7 +915,17 @@ def peer_coverage_ok(
         seen.add((key, off, shape))
         boxes.setdefault(key, []).append((off, shape))
     for key, shape in shapes.items():
-        if not _boxes_tile(tuple(shape), boxes.get(key, ())):
+        want = tuple(shape)
+        # a stale/version-skewed peer can advertise geometry of a
+        # different rank than the current template — non-contributing,
+        # never a crash (the decision degrades to disk, same as any
+        # other coverage miss)
+        usable = [
+            (o, e)
+            for o, e in boxes.get(key, ())
+            if len(o) == len(want) and len(e) == len(want)
+        ]
+        if not _boxes_tile(want, usable):
             return False
     return True
 
@@ -817,8 +948,8 @@ def load_from_pieces(
         ram = None
     if manifest is not None and manifest["step"] != step:
         manifest = None
-    index = _PieceIndex(manifest, ram, remotes=remotes)
     shapes, dtypes = template_schema(like)
+    index = _PieceIndex(manifest, ram, remotes=remotes, shapes=shapes)
     try:
         return _materialize(index, step, like, state_shardings, shapes, dtypes)
     finally:

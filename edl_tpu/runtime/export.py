@@ -308,11 +308,23 @@ def load_export_sharded(root: str, mesh, pspecs) -> Tuple[Any, Dict[str, Any]]:
         tree = pspecs(doc) if callable(pspecs) else pspecs
 
         def spec_for(parts) -> P:
+            # descends dicts AND lists: pspecs trees mirror the param
+            # structure, and several models carry list-valued layer
+            # stacks (resnet 'stages', ctr 'mlp' — the same structures
+            # _restore_lists rebuilds); a list node indexes by the
+            # decimal leaf-path part, so those leaves shard instead of
+            # silently falling back to replicated (ADVICE r4)
             node = tree
             for p in parts:
-                if not isinstance(node, dict) or p not in node:
+                if isinstance(node, (list, tuple)):
+                    try:
+                        node = node[int(p)]
+                    except (ValueError, IndexError):
+                        return P()
+                elif isinstance(node, dict) and p in node:
+                    node = node[p]
+                else:
                     return P()
-                node = node[p]
             return node if node is not None else P()
 
         params: Dict[str, Any] = {}

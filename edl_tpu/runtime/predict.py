@@ -1,0 +1,250 @@
+"""Batch prediction from a published export — every family, one verb.
+
+The reference's serving artifact is the inference model written by the
+trainer and consumed OFFLINE by a separate process (CTR:
+/root/reference/example/ctr/ctr/train.py:169-180 writes it each pass;
+the tutorial scores batches of Criteo rows against it). The TPU
+translation: exports carry an architecture record (``model`` in the
+manifest, written by every workload), and this module rebuilds the
+family's config + forward from that record alone — a consumer needs the
+export directory and a batch of rows, not the training repo config.
+
+``edl generate`` stays the llama *decoding* consumer (KV-cache
+autoregression); :func:`predict_batch` is the *scoring* consumer for
+every family:
+
+====== ======================= ===========================================
+family rows (npz keys)         outputs
+====== ======================= ===========================================
+ctr    dense [B,13] f32,       prob [B] (sigmoid click probability);
+       sparse [B,26] i32,      auc when label present
+       label [B] (optional)
+resnet images [B,H,W,C] f32,   class [B] top-1; acc when label present
+       label [B] (optional)
+bert   tokens [B,T] i32,       pred [B,T] top-1 token per position;
+       mask/targets (optional) masked_acc when mask+targets present
+llama  tokens [B,T] i32        next_token [B] (argmax after the last
+                               position); ppl over the batch when T >= 2
+moe    tokens [B,T] i32        same as llama
+====== ======================= ===========================================
+
+Forwards run chunked (LM logits are [rows, T, vocab] f32 — one
+unchunked call over a real batch would OOM the host), and ``--mesh``
+loads the params sharded over a device mesh via the SAME generic
+pspec rule training uses (``sharding.param_pspecs`` over a template
+built from the manifest), so bigger-than-HBM exports score at all.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+_CHUNK = 64  # rows per forward (matches worker_main._EVAL_CHUNK rationale)
+
+
+def _chunks(n: int):
+    for s in range(0, n, _CHUNK):
+        yield slice(s, min(s + _CHUNK, n))
+
+
+def template_from_doc(doc: Dict[str, Any]):
+    """ShapeDtypeStruct tree mirroring an export's param tree, built
+    from manifest shapes/dtypes alone — what the generic sharding rule
+    needs BEFORE any weight bytes load."""
+    import jax
+
+    from edl_tpu.runtime.export import _bf16, _restore_lists, _tree_insert
+
+    tree: Dict[str, Any] = {}
+    for key, shape in doc["shapes"].items():
+        name = doc["dtypes"].get(key, "float32")
+        dt = _bf16() if name == "bfloat16" else np.dtype(name)
+        _tree_insert(
+            tree, key.split("/"), jax.ShapeDtypeStruct(tuple(shape), dt)
+        )
+    return _restore_lists(tree)
+
+
+def load_params_for_predict(
+    export_dir: str, mesh_spec: Optional[str] = None
+) -> Tuple[Any, Dict[str, Any]]:
+    """(params, manifest) — host-resident, or sharded onto a device
+    mesh when ``mesh_spec`` (e.g. ``"fsdp=4"``) is given. The sharded
+    path reuses the generic training pspec rule over the manifest
+    template, so any family's export (dict OR list nodes) shards
+    without a model-specific layout."""
+    from edl_tpu.runtime.export import load_export, load_export_sharded
+
+    if not mesh_spec:
+        return load_export(export_dir)
+    import jax
+
+    from edl_tpu.parallel import sharding as shd
+    from edl_tpu.parallel.mesh import MeshPlan
+
+    plan = MeshPlan.parse(mesh_spec, len(jax.devices()))
+    mesh = plan.build()
+    return load_export_sharded(
+        export_dir,
+        mesh,
+        lambda d: shd.param_pspecs(template_from_doc(d), plan),
+    )
+
+
+def predict_batch(
+    params: Any, doc: Dict[str, Any], rows: Dict[str, np.ndarray]
+) -> Dict[str, Any]:
+    """Family-dispatched scoring of ``rows`` against an export's
+    params. Returns per-row outputs plus any metrics the provided
+    labels allow (see module table). Raises ValueError for an export
+    without a usable architecture record."""
+    meta = doc.get("model") or {}
+    family = meta.get("family")
+    if family == "ctr":
+        return _predict_ctr(params, rows)
+    if family == "resnet":
+        return _predict_resnet(params, meta, rows)
+    if family == "bert":
+        return _predict_bert(params, meta, rows)
+    if family == "llama":
+        return _predict_lm(params, meta, rows, family)
+    if family == "moe":
+        return _predict_lm(params, meta, rows, family)
+    raise ValueError(
+        f"export has no architecture record predict understands "
+        f"(model={meta or None}); re-export with model_meta"
+    )
+
+
+def _need(rows: Dict[str, np.ndarray], *keys: str) -> None:
+    missing = [k for k in keys if k not in rows]
+    if missing:
+        raise ValueError(
+            f"input rows missing {missing}; have {sorted(rows)}"
+        )
+
+
+def _predict_ctr(params, rows) -> Dict[str, Any]:
+    import jax
+    import jax.numpy as jnp
+
+    from edl_tpu.models import ctr
+
+    _need(rows, "dense", "sparse")
+    dense = np.asarray(rows["dense"], np.float32)
+    sparse = np.asarray(rows["sparse"], np.int32)
+    fwd = jax.jit(ctr.forward)
+    logits = np.concatenate([
+        np.asarray(fwd(params, jnp.asarray(dense[c]), jnp.asarray(sparse[c])))
+        for c in _chunks(len(dense))
+    ])
+    out: Dict[str, Any] = {"prob": 1.0 / (1.0 + np.exp(-logits))}
+    if "label" in rows:
+        import jax.numpy as jnp
+
+        out["auc"] = float(
+            ctr.batch_auc(
+                jnp.asarray(logits),
+                jnp.asarray(np.asarray(rows["label"]), jnp.float32),
+            )
+        )
+    return out
+
+
+def _predict_resnet(params, meta, rows) -> Dict[str, Any]:
+    import jax
+    import jax.numpy as jnp
+
+    from edl_tpu.models import resnet
+
+    _need(rows, "images")
+    cfg = resnet.ResNetConfig.from_meta(meta)
+    images = np.asarray(rows["images"], np.float32)
+    fwd = jax.jit(lambda p, x: resnet.forward(p, x, cfg))
+    cls = np.concatenate([
+        np.asarray(jnp.argmax(fwd(params, jnp.asarray(images[c])), -1))
+        for c in _chunks(len(images))
+    ])
+    out: Dict[str, Any] = {"class": cls}
+    if "label" in rows:
+        out["acc"] = float(
+            (cls == np.asarray(rows["label"]).reshape(-1)).mean()
+        )
+    return out
+
+
+def _predict_bert(params, meta, rows) -> Dict[str, Any]:
+    import jax
+    import jax.numpy as jnp
+
+    from edl_tpu.models import bert
+
+    _need(rows, "tokens")
+    cfg = bert.BertConfig.from_meta(meta)
+    toks = np.asarray(rows["tokens"], np.int32)
+    fwd = jax.jit(lambda p, t: bert.forward(p, t, cfg))
+    pred = np.concatenate([
+        np.asarray(jnp.argmax(fwd(params, jnp.asarray(toks[c])), -1))
+        for c in _chunks(len(toks))
+    ])
+    out: Dict[str, Any] = {"pred": pred}
+    if "mask" in rows and "targets" in rows:
+        mask = np.asarray(rows["mask"]) > 0
+        out["masked_acc"] = float(
+            (pred[mask] == np.asarray(rows["targets"])[mask]).mean()
+        ) if mask.any() else 0.0
+    return out
+
+
+def _predict_lm(params, meta, rows, family: str) -> Dict[str, Any]:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    _need(rows, "tokens")
+    if family == "llama":
+        from edl_tpu.models import llama as mod
+
+        cfg = mod.LlamaConfig.from_meta(meta)
+        fwd = jax.jit(lambda p, t: mod.forward(p, t, cfg))
+    else:
+        from edl_tpu.models import moe as mod
+
+        cfg = mod.MoEConfig.from_meta(meta)
+        fwd = jax.jit(lambda p, t: mod.forward(p, t, cfg)[0])
+    toks = np.asarray(rows["tokens"], np.int32)
+    nxt, total, count = [], 0.0, 0
+    for c in _chunks(len(toks)):
+        t = jnp.asarray(toks[c])
+        logits = fwd(params, t)
+        nxt.append(np.asarray(jnp.argmax(logits[:, -1], -1)))
+        if toks.shape[1] >= 2:
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], t[:, 1:]
+            )
+            total += float(jnp.sum(ce))
+            count += ce.size
+    out: Dict[str, Any] = {"next_token": np.concatenate(nxt)}
+    if count:
+        out["ppl"] = float(np.exp(total / count))
+    return out
+
+
+def load_rows(
+    path: Optional[str] = None,
+    data_dir: Optional[str] = None,
+    n_rows: int = 256,
+) -> Dict[str, np.ndarray]:
+    """Rows from an ``.npz`` file OR the head of a shards-dir dataset
+    (runtime/shards.py — the same format the training pipeline reads)."""
+    if (path is None) == (data_dir is None):
+        raise ValueError("give exactly one of path / data_dir")
+    if path is not None:
+        with np.load(path, allow_pickle=False) as z:
+            return {k: z[k] for k in z.files}
+    from edl_tpu.runtime.shards import FileShardSource
+
+    src = FileShardSource(data_dir)
+    return src.fetch_range(0, min(n_rows, src.n_samples))

@@ -141,6 +141,12 @@ class WorkerConfig:
     # example/ctr/ctr/train.py:161-167). Requires export_dir and a
     # workload that defines eval_fn.
     eval_dir: str = ""
+    # eval resource bounds (ADVICE r4): the held-out split is CAPPED
+    # (not the whole dir into leader RAM), and EDL_EVAL_DEVICE=cpu
+    # moves the forward passes off the accelerator so eval never
+    # contends with the training step loop for HBM.
+    eval_max_rows: int = 4096
+    eval_device: str = ""
     # TPU slice this host belongs to (multi-slice topology). -1 =
     # unknown: the mesh build falls back to the hardware's own
     # ``device.slice_index`` (real multislice TPU exposes it). When set
@@ -191,6 +197,8 @@ class WorkerConfig:
             p2p=e.get("EDL_P2P", "1") != "0",
             p2p_linger_s=float(e.get("EDL_P2P_LINGER_S", "20")),
             eval_dir=e.get("EDL_EVAL_DIR", ""),
+            eval_max_rows=int(e.get("EDL_EVAL_MAX_ROWS", "4096")),
+            eval_device=e.get("EDL_EVAL_DEVICE", ""),
             # MEGASCALE_SLICE_ID is what GKE injects into multislice
             # TPU pods — honoring it makes the kube path slice-aware
             # with no manifest change
@@ -287,6 +295,17 @@ def _ctr_workload(cfg: WorkerConfig) -> Workload:
         ctr.make_loss_fn(),
         batch_fn,
         eval_fn=eval_auc,
+        # architecture record so `edl predict` can score a CTR export
+        # offline — THE reference serving artifact
+        # (example/ctr/ctr/train.py:169-180). ctr.forward reads its
+        # architecture from the params themselves; the record is the
+        # family dispatch + provenance.
+        model_meta={
+            "family": "ctr",
+            "vocab": cfg.vocab,
+            "emb": cfg.emb or ctr.DEFAULT_EMBEDDING,
+            "mlp_dims": list(ctr.MLP_DIMS),
+        },
     )
 
 
@@ -544,6 +563,24 @@ def _clear_backends() -> None:
         jax.extend.backend.clear_backends()
 
 
+_VETO_TTL_EPOCHS = 4
+
+
+def _veto_active(raw: Optional[str], epoch: int) -> bool:
+    """Whether a per-step p2p veto KV value (the epoch it was written)
+    is still in force. One key PER STEP, written blindly on failure:
+    writes for different steps never race each other, so no veto can be
+    lost to a read-modify-write interleaving (a single set-valued key
+    would let a straggler's stale write resurrect a doomed step).
+    Malformed values read as expired rather than wedging the decision."""
+    if not raw:
+        return False
+    try:
+        return epoch - int(raw) <= _VETO_TTL_EPOCHS
+    except ValueError:
+        return False
+
+
 # --------------------------------------------------------------------------
 # the worker
 
@@ -571,10 +608,12 @@ class ElasticWorker:
         self._gc_keys: list = []
         self._gc_later: list = []
         self._shard_server = None  # p2p shard service (run())
+        self._p2p_token = None  # per-job shard-plane auth (run())
         self._incarnation = 0  # set at bootstrap; bumped to force regroup
         self._restore_failures = 0
         self._eval_fn = None  # workload eval hook (run(), cfg.eval_dir)
-        self._eval_rows = None  # held-out split, loaded once
+        self._eval_rows = None  # held-out split, loaded once (capped)
+        self._eval_failures = 0  # consecutive eval failures (KV-surfaced)
 
     # -- keys ----------------------------------------------------------------
     def _k(self, *parts: str) -> str:
@@ -775,7 +814,7 @@ class ElasticWorker:
         lock = threading.Lock()
 
         def probe(name, addr):
-            got = fetch_index(addr, timeout_s=1.0)
+            got = fetch_index(addr, timeout_s=1.0, token=self._p2p_token)
             if got is not None and got[0] >= 0:
                 with lock:
                     out[name] = (addr, got[0], got[1])
@@ -808,6 +847,9 @@ class ElasticWorker:
         from edl_tpu.runtime import checkpoint as ckpt
         from edl_tpu.runtime.shard_server import RemotePieces
 
+        # converge on the job token (a cold-start write race can leave
+        # an early worker holding the losing value; KV is the truth)
+        self._p2p_token = cl.kv_get(self._k("p2p_token")) or self._p2p_token
         dkey = self._k("restore", str(epoch))
         peers = None
         if rank == 0:
@@ -824,21 +866,20 @@ class ElasticWorker:
             # pieces but fetches failed) vetoes that step for a few
             # epochs — otherwise a deterministic decision re-picks the
             # doomed step every regroup until the failure abort, even
-            # though the manifest fallback was available (ADVICE r4)
-            veto_step = -1
-            raw_veto = cl.kv_get(self._k("p2p_veto"))
-            if raw_veto:
-                try:
-                    vs, ve = raw_veto.split(":")
-                    if epoch - int(ve) <= 4:
-                        veto_step = int(vs)
-                except ValueError:
-                    pass
+            # though the manifest fallback was available (ADVICE r4).
+            # One KV key per vetoed step (see _veto_active): vetoes for
+            # different steps can neither ping-pong a shared slot nor
+            # lose each other to concurrent read-modify-writes.
             decision = "none"
             for s in cand:
                 if s < m_step:
                     break  # never restore older than the committed truth
-                if s == veto_step:
+                # NO GC delete of expired veto keys here: a read-then-
+                # delete could race a straggler's fresh blind write and
+                # erase an ACTIVE veto. The keys are a few bytes each
+                # and only exist for steps whose restore actually
+                # failed — boundedness comes from rarity, not reaping.
+                if _veto_active(cl.kv_get(self._k("p2p_veto", str(s))), epoch):
                     continue
                 entries = [
                     e
@@ -893,7 +934,7 @@ class ElasticWorker:
         if peers is None:
             peers = self._probe_peers(cl)
         remotes = [
-            RemotePieces(addr, entries)
+            RemotePieces(addr, entries, token=self._p2p_token)
             for (addr, s, entries) in peers.values()
             if s == step
         ]
@@ -907,9 +948,10 @@ class ElasticWorker:
         except Exception:
             # veto this step so the regroup's next decision falls
             # through to the manifest instead of re-picking it (the
-            # veto key is NOT epoch-scoped: it must outlive this epoch)
+            # veto key is NOT epoch-scoped: it must outlive this epoch;
+            # one key per step — a blind, raceless write)
             try:
-                cl.kv_put(self._k("p2p_veto"), f"{step}:{epoch}")
+                cl.kv_put(self._k("p2p_veto", str(step)), str(epoch))
             except Exception:
                 pass
             raise
@@ -930,17 +972,42 @@ class ElasticWorker:
         if not cfg.eval_dir or self._eval_fn is None:
             return
         try:
+            import contextlib
+
             from edl_tpu.runtime.export import load_export
             from edl_tpu.runtime.shards import FileShardSource
 
             if self._eval_rows is None:
                 src = FileShardSource(cfg.eval_dir)
-                self._eval_rows = src.fetch_range(0, src.n_samples)
+                # cap, don't slurp: the split lives in leader host RAM
+                # for the job's lifetime (ADVICE r4)
+                self._eval_rows = src.fetch_range(
+                    0, min(src.n_samples, cfg.eval_max_rows)
+                )
             params, _ = load_export(cfg.export_dir)
-            metric = float(self._eval_fn(params, self._eval_rows))
+            ctx = contextlib.nullcontext()
+            if cfg.eval_device == "cpu":
+                # off the accelerator: eval forwards must not contend
+                # with the training step loop for HBM
+                import jax
+
+                ctx = jax.default_device(jax.devices("cpu")[0])
+            with ctx:
+                metric = float(self._eval_fn(params, self._eval_rows))
             client.kv_put(self._k("eval_metric"), f"{step}:{metric:.6f}")
             log.info("eval", step=step, metric=round(metric, 6))
+            self._eval_failures = 0
         except Exception as e:  # pragma: no cover - eval is best-effort
+            # best-effort, but NOT silent: repeated failures (e.g. the
+            # eval OOMing the leader every commit) surface in KV where
+            # the monitor/CLI can see them, not just a local log line
+            self._eval_failures += 1
+            try:
+                client.kv_put(
+                    self._k("eval_failures"), str(self._eval_failures)
+                )
+            except Exception:
+                pass
             log.warn("export eval failed", error=str(e))
 
     def _join_pending_commit(self) -> None:
@@ -1217,7 +1284,22 @@ class ElasticWorker:
             # (pod IP in production; loopback for local jobs).
             from edl_tpu.runtime.shard_server import ShardServer
 
-            self._shard_server = ShardServer(lambda: self._ram_snapshot)
+            # per-job token gates the weight plane (ADVICE r4): first
+            # worker to look writes one; everyone converges on the KV
+            # value (re-read after write — last write wins for all)
+            tok = self.client.kv_get(self._k("p2p_token"))
+            if not tok:
+                import secrets
+
+                self.client.kv_put(
+                    self._k("p2p_token"), secrets.token_hex(16)
+                )
+                tok = self.client.kv_get(self._k("p2p_token"))
+            self._p2p_token = tok
+            self._shard_server = ShardServer(
+                lambda: self._ram_snapshot,
+                check_token=lambda t: bool(t) and t == self._p2p_token,
+            )
             self.client.kv_put(
                 self._k("shardsrv", cfg.worker_id),
                 f"{os.environ.get('EDL_HOST_ADDR', '127.0.0.1')}:"

@@ -43,6 +43,10 @@ def main() -> int:
                     help="shard-manifest dataset dir; prepared with "
                     "synthetic rows when absent (the reference pre-bakes "
                     "RecordIO shards into the job image)")
+    ap.add_argument("--real-data", action="store_true",
+                    help="prepare REAL rows (examples/ctr/real_data.py "
+                    "encoding of the bundled breast-cancer set) instead "
+                    "of synthetic ones; implies their vocab")
     ap.add_argument("--samples", type=int, default=65536)
     ap.add_argument("--sync-every", type=int, default=1,
                     help="delayed-sync DP: K local steps per dp group "
@@ -83,9 +87,19 @@ def main() -> int:
     # -- dataset: real files, prepared once (image-prebake analog) ---------
     data_dir = args.data_dir or tempfile.mkdtemp(prefix="ctr_shards_")
     if not os.path.exists(os.path.join(data_dir, "manifest.json")):
-        rows = ctr.synthetic_batch(rng, args.samples, vocab=args.vocab)
-        write_shards(data_dir, rows, shard_size=8192)
-        print(f"prepared {args.samples} rows of CTR data under {data_dir}")
+        if args.real_data:
+            import real_data
+
+            man = real_data.prepare(data_dir)
+            args.vocab = real_data.VOCAB
+            print(
+                f"prepared {man['n_samples']} REAL rows of CTR data "
+                f"under {data_dir}"
+            )
+        else:
+            rows = ctr.synthetic_batch(rng, args.samples, vocab=args.vocab)
+            write_shards(data_dir, rows, shard_size=8192)
+            print(f"prepared {args.samples} rows of CTR data under {data_dir}")
     source = FileShardSource(data_dir)
     queue = ElasticDataQueue(
         source.n_samples, chunk_size=512, passes=10**6

@@ -1,0 +1,173 @@
+"""edl predict — the family-universal serving consumer (VERDICT r4 #2).
+
+The reference's serving artifact is the offline CTR inference model
+(/root/reference/example/ctr/ctr/train.py:169-180) scored by a separate
+process; here every family's export carries an architecture record and
+``predict_batch`` rebuilds config + forward from it alone."""
+
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from edl_tpu.runtime import predict as pred
+from edl_tpu.runtime.export import export_params
+
+
+def _ctr_export(tmp_path, vocab=512):
+    from edl_tpu.models import ctr
+
+    params = ctr.init_params(jax.random.PRNGKey(0), vocab=vocab, emb=8)
+    export_params(
+        str(tmp_path), params, step=3, dtype="none",
+        model_meta={
+            "family": "ctr", "vocab": vocab, "emb": 8,
+            "mlp_dims": list(ctr.MLP_DIMS),
+        },
+    )
+    rows = ctr.synthetic_batch(np.random.RandomState(0), 96, vocab=vocab)
+    return rows
+
+
+def test_predict_ctr_prob_and_auc(tmp_path):
+    rows = _ctr_export(tmp_path)
+    params, doc = pred.load_params_for_predict(str(tmp_path))
+    out = pred.predict_batch(params, doc, rows)
+    assert out["prob"].shape == (96,)
+    assert np.all((out["prob"] >= 0) & (out["prob"] <= 1))
+    assert 0.0 <= out["auc"] <= 1.0
+    # without labels: no metric, same probs
+    out2 = pred.predict_batch(
+        params, doc, {k: rows[k] for k in ("dense", "sparse")}
+    )
+    np.testing.assert_allclose(out2["prob"], out["prob"], rtol=1e-6)
+    assert "auc" not in out2
+
+
+def test_predict_ctr_sharded_mesh(tmp_path, cpu_devices):
+    """--mesh path: the generic pspec rule shards a LIST-bearing param
+    tree (ctr's mlp stack — the ADVICE r4 spec_for case) and scoring
+    matches the host-resident load bit-for-bit."""
+    rows = _ctr_export(tmp_path)
+    params_h, doc = pred.load_params_for_predict(str(tmp_path))
+    params_s, doc_s = pred.load_params_for_predict(str(tmp_path), "fsdp")
+    out_h = pred.predict_batch(params_h, doc, rows)
+    out_s = pred.predict_batch(params_s, doc_s, rows)
+    np.testing.assert_allclose(out_s["prob"], out_h["prob"], rtol=1e-5)
+    # the big leaf actually sharded (not replicated fallback)
+    emb = params_s["embedding"]
+    assert len(emb.sharding.device_set) > 1
+    spec = emb.sharding.spec
+    assert any(s is not None for s in spec)
+
+
+def test_predict_resnet(tmp_path):
+    from edl_tpu.models import resnet
+
+    cfg = resnet.ResNetConfig.tiny(num_classes=7)
+    params = resnet.init_params(jax.random.PRNGKey(1), cfg)
+    export_params(
+        str(tmp_path), params, step=2, dtype="none",
+        model_meta=cfg.to_meta(),
+    )
+    rows = resnet.synthetic_batch(
+        np.random.RandomState(0), 24, size=16, num_classes=7
+    )
+    params2, doc = pred.load_params_for_predict(str(tmp_path))
+    out = pred.predict_batch(params2, doc, rows)
+    assert out["class"].shape == (24,)
+    assert set(np.unique(out["class"])).issubset(set(range(7)))
+    assert 0.0 <= out["acc"] <= 1.0
+
+
+def test_predict_bert_masked(tmp_path):
+    from edl_tpu.models import bert
+
+    cfg = bert.BertConfig.tiny(vocab=128)
+    params = bert.init_params(jax.random.PRNGKey(2), cfg)
+    export_params(
+        str(tmp_path), params, step=5, dtype="none",
+        model_meta=cfg.to_meta(),
+    )
+    rows = bert.synthetic_mlm_batch(np.random.RandomState(0), 16, 12, 128)
+    params2, doc = pred.load_params_for_predict(str(tmp_path))
+    out = pred.predict_batch(params2, doc, rows)
+    assert out["pred"].shape == rows["tokens"].shape
+    assert 0.0 <= out["masked_acc"] <= 1.0
+
+
+@pytest.mark.parametrize("family", ["llama", "moe"])
+def test_predict_lm_next_token_and_ppl(tmp_path, family):
+    if family == "llama":
+        from edl_tpu.models import llama as mod
+
+        cfg = mod.LlamaConfig.tiny(vocab=128)
+    else:
+        from edl_tpu.models import moe as mod
+
+        cfg = mod.MoEConfig.tiny(vocab=128)
+    params = mod.init_params(jax.random.PRNGKey(3), cfg)
+    export_params(
+        str(tmp_path), params, step=9, dtype="none",
+        model_meta=cfg.to_meta(),
+    )
+    toks = np.random.RandomState(0).randint(0, 128, (8, 10)).astype(np.int32)
+    params2, doc = pred.load_params_for_predict(str(tmp_path))
+    out = pred.predict_batch(params2, doc, {"tokens": toks})
+    assert out["next_token"].shape == (8,)
+    assert out["ppl"] > 0
+
+
+def test_predict_rejects_recordless_export(tmp_path):
+    export_params(
+        str(tmp_path), {"w": np.ones((2, 2), np.float32)}, step=1,
+        dtype="none",
+    )
+    params, doc = pred.load_params_for_predict(str(tmp_path))
+    with pytest.raises(ValueError, match="architecture record"):
+        pred.predict_batch(params, doc, {"tokens": np.zeros((1, 2), np.int32)})
+
+
+def test_config_from_meta_roundtrip():
+    """from_meta inverts to_meta across the JSON boundary (tuples ride
+    as lists) for every family that carries a config dataclass."""
+    import json
+
+    from edl_tpu.models import bert, llama, moe, resnet
+
+    for cfg in (
+        resnet.ResNetConfig.tiny(num_classes=5),
+        bert.BertConfig.tiny(vocab=64),
+        moe.MoEConfig.tiny(vocab=64),
+        llama.LlamaConfig.tiny(vocab=64),
+    ):
+        meta = json.loads(json.dumps(cfg.to_meta()))
+        back = type(cfg).from_meta(meta)
+        for f in ("vocab", "d_model", "widths", "num_classes"):
+            if hasattr(cfg, f):
+                assert getattr(back, f) == getattr(cfg, f), f
+
+
+def test_cli_predict_end_to_end(tmp_path):
+    """The CLI verb over a real export + npz input, in a subprocess
+    (the consumer's actual invocation)."""
+    from edl_tpu.models import ctr
+
+    export_dir = tmp_path / "export"
+    rows = _ctr_export(export_dir)
+    npz = tmp_path / "rows.npz"
+    np.savez(npz, **rows)
+    out_npz = tmp_path / "scored.npz"
+    r = subprocess.run(
+        [
+            sys.executable, "-m", "edl_tpu.cli.main", "predict",
+            str(export_dir), "--input", str(npz), "--out", str(out_npz),
+        ],
+        capture_output=True, text=True, timeout=240,
+    )
+    assert r.returncode == 0, r.stderr
+    assert "family=ctr" in r.stdout and "auc=" in r.stdout
+    with np.load(out_npz) as z:
+        assert z["prob"].shape == (96,)
