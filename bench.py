@@ -327,12 +327,14 @@ def _p2p_bench() -> dict:
     # --- aggregate: 4 fetcher procs x 4 server procs, all-to-all ---
     n_srv, n_fetch = 4, 4
     procs, ports = _p2p_spawn_servers(n_srv, n_pieces=4, rows=8192)
+    fetchers = []
     try:
         port_arg = ",".join(str(p) for p in ports)
         fetchers = [
             subprocess.Popen(
                 [_sys.executable, "-c", _P2P_FETCHER_SRC, port_arg, "2"],
-                stdout=subprocess.PIPE, env=_p2p_env(), text=True,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                env=_p2p_env(), text=True,
             )
             for _ in range(n_fetch)
         ]
@@ -340,11 +342,16 @@ def _p2p_bench() -> dict:
         worst = 0.0
         for f in fetchers:
             out = f.stdout.readline().split()
+            if len(out) != 2:
+                # a dead fetcher's real traceback, not an IndexError
+                raise RuntimeError(
+                    f"p2p fetcher died: {f.stderr.read()[-500:]}"
+                )
             agg_bytes += int(out[0])
             worst = max(worst, float(out[1]))
             f.wait(timeout=30)
     finally:
-        for p in procs:
+        for p in procs + fetchers:
             p.kill()
     agg_bw = agg_bytes / worst if worst else 0.0
 
@@ -357,15 +364,45 @@ def _p2p_bench() -> dict:
     }
 
 
+def _peak_hbm_bw(device) -> float:
+    """Per-chip HBM bandwidth by device kind (bytes/s). Decode is
+    BW-bound, so this is the denominator of its roofline."""
+    kind = getattr(device, "device_kind", "").lower()
+    if "v5 lite" in kind or "v5e" in kind or "v5lite" in kind:
+        return 819e9
+    if "v4" in kind:
+        return 1228e9
+    if "v5p" in kind or "v5" in kind:
+        return 2765e9
+    if "v6" in kind:
+        return 1640e9
+    return 819e9  # conservative default (v5e-class)
+
+
+def _decode_step_bytes(cfg, param_bytes: int, b: int, s_pad: int) -> float:
+    """HBM bytes one decode step must move: every parameter byte
+    (weights stream once per token — the defining cost of small-batch
+    decode) plus the FULL padded KV cache (the masked-dense decode
+    attention reads all S slots every step, by construction:
+    models/llama.py _decode_step einsums over s = max_len). Activation
+    traffic at B<=32 is noise next to these two."""
+    kv_bytes = 2 * cfg.n_layers * b * s_pad * cfg.n_kv_heads * cfg.head_dim * 2
+    return param_bytes + kv_bytes
+
+
 def _llama_decode_bench() -> dict:
     """Serving-path metrics for the KV-cache decode (runtime/export.py
-    consumer; VERDICT r3 #3): prefill latency for one [B, T0] prompt
-    batch and steady-state decode tokens/s. Same flagship architecture
-    as the train bench, bf16 params (the export dtype), no remat —
-    inference holds no optimizer state. Greedy decode: the generate
-    program is one jit (prefill + lax.scan over positions), so the
-    measured rate includes cache updates and sampling, not per-token
-    dispatch."""
+    consumer; VERDICT r3 #3): prefill latency, steady-state decode
+    tokens/s, and — VERDICT r4 #3 — the HBM-bandwidth roofline
+    accounting for each point of a small batch ladder
+    (``decode_pct_peak_bw``: achieved bytes/s over the chip's peak;
+    decode moves every weight byte plus the whole padded cache per
+    step, so %-of-peak IS the efficiency of the decode program). Same
+    flagship architecture as the train bench, bf16 params (the export
+    dtype), no remat — inference holds no optimizer state. Greedy
+    decode: the generate program is one jit (prefill + lax.scan over
+    positions), so the measured rate includes cache updates and
+    sampling, not per-token dispatch."""
     from edl_tpu.models import llama
 
     on_tpu = jax.devices()[0].platform == "tpu"
@@ -374,70 +411,108 @@ def _llama_decode_bench() -> dict:
             vocab=32768, d_model=2048, n_layers=16, n_heads=16,
             n_kv_heads=8, d_ff=6144, dtype=jnp.bfloat16, use_flash=True,
         )
-        b, t0, max_new = 8, 512, 64
+        ladder = [(1, 512, 64), (8, 512, 64), (32, 512, 64)]
+        headline = 8
     else:
         cfg = llama.LlamaConfig(
             vocab=1024, d_model=128, n_layers=2, n_heads=4, n_kv_heads=2,
             d_ff=384, dtype=jnp.float32,
         )
-        b, t0, max_new = 2, 32, 8
+        ladder = [(2, 32, 8)]
+        headline = 2
     # bf16 params: what load_export hands a serving process
     params = jax.tree_util.tree_map(
         lambda x: x.astype(jnp.bfloat16) if on_tpu else x,
         jax.jit(lambda: llama.init_params(jax.random.PRNGKey(2), cfg))(),
     )
-    prompt = jnp.asarray(
-        np.random.RandomState(3).randint(0, cfg.vocab, (b, t0), np.int32)
+    peak_bw = _peak_hbm_bw(jax.devices()[0])
+    param_bytes = sum(
+        x.size * x.dtype.itemsize for x in jax.tree_util.tree_leaves(params)
     )
 
-    # decode rate by DIFFERENCING two generation lengths: both programs
-    # share an identical prefill + cache build, so the per-run tunnel
-    # jitter on the prefill cancels out of the steady-state decode rate
-    # (a prefill-subtraction estimate swung >50% between bench runs);
-    # prefill_s is then derived by extrapolating the decode cost back
-    # out of the short run.
-    short, long_ = max_new // 2, max_new + max_new // 2
+    def measure(b, t0, max_new):
+        """(prefill_s, per_tok_s or None) by DIFFERENCING two
+        generation lengths: both programs share an identical prefill +
+        cache build, so the per-run tunnel jitter on the prefill
+        cancels out of the steady-state decode rate (a
+        prefill-subtraction estimate swung >50% between bench runs);
+        prefill_s is then derived by extrapolating the decode cost
+        back out of the short run."""
+        prompt = jnp.asarray(
+            np.random.RandomState(3).randint(0, cfg.vocab, (b, t0), np.int32)
+        )
+        short, long_ = max_new // 2, max_new + max_new // 2
 
-    def timed_gen(n):
-        toks = llama.generate(params, prompt, cfg, max_new=n)
-        int(np.asarray(toks)[0, -1])  # compile + dependent-fetch fence
-        best = float("inf")
-        for _ in range(2):
-            t1 = time.perf_counter()
+        def timed_gen(n):
             toks = llama.generate(params, prompt, cfg, max_new=n)
-            int(np.asarray(toks)[0, -1])
-            best = min(best, time.perf_counter() - t1)
-        return best
+            int(np.asarray(toks)[0, -1])  # compile + dependent-fetch fence
+            best = float("inf")
+            for _ in range(2):
+                t1 = time.perf_counter()
+                toks = llama.generate(params, prompt, cfg, max_new=n)
+                int(np.asarray(toks)[0, -1])
+                best = min(best, time.perf_counter() - t1)
+            return best
 
-    # bias note: the two programs pad their KV caches to different
-    # max_len (t0+short vs t0+long_), so the long run's decode steps
-    # attend over a slightly larger S — per_tok is a small systematic
-    # OVERestimate (conservative direction) at these sizes, not a
-    # cancellation-breaking error.
-    t_short = timed_gen(short)
-    t_long = timed_gen(long_)
+        # bias note: the two programs pad their KV caches to different
+        # max_len (t0+short vs t0+long_), so the long run's decode
+        # steps attend over a slightly larger S — per_tok is a small
+        # systematic OVERestimate (conservative direction) at these
+        # sizes, not a cancellation-breaking error.
+        t_short = timed_gen(short)
+        t_long = timed_gen(long_)
+        if t_long <= t_short * 1.02:
+            return -1.0, None  # tunnel jitter swamped the window
+        per_tok = (t_long - t_short) / (long_ - short)
+        prefill_s = t_short - short * per_tok
+        return (prefill_s if prefill_s >= 0 else -1.0), per_tok
+
+    out: dict = {}
+    rungs = []
+    for b, t0, max_new in ladder:
+        prefill_s, per_tok = measure(b, t0, max_new)
+        if per_tok is None:
+            rungs.append({
+                "b": b, "t0": t0,
+                "decode_tokens_per_sec": -1.0,
+                "decode_pct_peak_bw": -1.0,  # consistent rung schema
+            })
+            if b == headline:
+                out.update({
+                    "prefill_s": -1.0,
+                    "decode_tokens_per_sec": -1.0,
+                    "decode_pct_peak_bw": -1.0,
+                    "decode_config": f"B{b}/T0{t0}:jitter",
+                })
+            continue
+        # roofline: bytes the step MUST move over the measured step
+        # time. Only meaningful against a TPU's HBM — the CPU smoke
+        # path publishes the explicit -1.0 marker, same policy as the
+        # jitter branch (never a plausible-looking nonsense number).
+        s_pad = t0 + max_new + max_new // 2  # the long program's padding
+        pct = (
+            _decode_step_bytes(cfg, param_bytes, b, s_pad) / per_tok / peak_bw
+            if on_tpu
+            else -1.0
+        )
+        rung = {
+            "b": b,
+            "t0": t0,
+            "decode_tokens_per_sec": round(b / per_tok, 1),
+            "decode_pct_peak_bw": round(pct, 4),
+        }
+        rungs.append(rung)
+        if b == headline:
+            out.update({
+                "prefill_s": round(prefill_s, 4),
+                "decode_tokens_per_sec": rung["decode_tokens_per_sec"],
+                "decode_pct_peak_bw": rung["decode_pct_peak_bw"],
+                "decode_config": f"B{b}/T0{t0}/new{max_new//2}-{max_new+max_new//2}",
+            })
+    out["decode_ladder"] = rungs
     del params
     jax.clear_caches()
-    if t_long <= t_short * 1.02:
-        # tunnel jitter swamped the differencing window: publish an
-        # explicit failure marker, never a nonsense rate
-        return {
-            "prefill_s": -1.0,
-            "decode_tokens_per_sec": -1.0,
-            "decode_config": f"B{b}/T0{t0}/new{short}-{long_}:jitter",
-        }
-    per_tok = (t_long - t_short) / (long_ - short)
-    prefill_s = t_short - short * per_tok
-    if prefill_s < 0:
-        # per_tok over-estimated past the whole short run: the prefill
-        # extrapolation is meaningless — same failure-marker policy as
-        # the jitter branch, never a silent 0.0
-        prefill_s = -1.0
-    return {
-        "prefill_s": round(prefill_s, 4),
-        "decode_tokens_per_sec": round(b / per_tok, 1),
-        "decode_config": f"B{b}/T0{t0}/new{short}-{long_}",
-    }
+    return out
 
 
 def main() -> None:

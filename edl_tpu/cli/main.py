@@ -555,7 +555,11 @@ def run_predict(args) -> int:
         print(str(e), file=sys.stderr)
         return 1
     except ValueError as e:
-        print(f"bad --mesh {args.mesh!r}: {e}", file=sys.stderr)
+        # a ValueError here is only a mesh problem when a mesh was
+        # actually given — export decode errors must not be blamed on
+        # an argument the user never passed
+        blame = f"bad --mesh {args.mesh!r}: " if args.mesh else "predict failed: "
+        print(f"{blame}{e}", file=sys.stderr)
         return 1
     try:
         out = predict_batch(params, doc, rows)

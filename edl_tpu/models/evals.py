@@ -1,0 +1,78 @@
+"""Shared chunked evaluation math — ONE implementation of the
+held-out metrics that both the in-job eval hook (runtime/workloads.py
+-> runtime/eval_hook.py) and the offline serving consumer
+(runtime/predict.py, `edl predict`) publish. If these diverged, the
+in-job ``eval_metric`` and an offline re-score of the same export
+would silently disagree.
+
+Everything is chunked: LM heads emit [rows, T, vocab] f32 logits — one
+unchunked call over a real split would OOM the host driving it."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple
+
+import numpy as np
+
+CHUNK = 64  # rows per forward
+
+
+def lm_scan(
+    logits_fn: Callable, params, toks: np.ndarray, chunk: int = CHUNK
+) -> Tuple[np.ndarray, float, int]:
+    """One chunked pass over ``toks [N, T]``: (greedy next token after
+    the last position [N], total next-token CE, CE count). CE covers
+    positions 0..T-2 predicting 1..T-1 (empty when T < 2)."""
+    import jax.numpy as jnp
+    import optax
+
+    toks = np.asarray(toks)
+    nxt = []
+    total, count = 0.0, 0
+    for s in range(0, len(toks), chunk):
+        t = jnp.asarray(toks[s : s + chunk])
+        logits = logits_fn(params, t)
+        nxt.append(np.asarray(jnp.argmax(logits[:, -1], -1)))
+        if toks.shape[1] >= 2:
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], t[:, 1:]
+            )
+            total += float(jnp.sum(ce))
+            count += ce.size
+    return np.concatenate(nxt) if nxt else np.zeros((0,), np.int32), total, count
+
+
+def lm_ppl(logits_fn: Callable, params, toks: np.ndarray, chunk: int = CHUNK) -> float:
+    """Next-token perplexity over ``toks [N, T]`` (the in-job LM
+    eval_metric; reference parity: metric fetched in the train loop,
+    example/ctr/ctr/train.py:161-167)."""
+    _, total, count = lm_scan(logits_fn, params, toks, chunk)
+    return float(np.exp(total / max(count, 1)))
+
+
+def masked_top1(
+    logits_fn: Callable, params, rows: Dict[str, np.ndarray], chunk: int = CHUNK
+) -> Tuple[float, np.ndarray]:
+    """(masked top-1 accuracy, per-position predictions [N, T]) over
+    ``{tokens, mask, targets}`` MLM rows — accuracy counted only where
+    mask > 0; 0.0 when nothing is masked."""
+    import jax.numpy as jnp
+
+    toks = np.asarray(rows["tokens"])
+    preds = []
+    correct = total = 0
+    for s in range(0, len(toks), chunk):
+        sl = slice(s, s + chunk)
+        logits = logits_fn(params, jnp.asarray(toks[sl]))
+        pred = np.asarray(jnp.argmax(logits, -1))
+        preds.append(pred)
+        if "mask" in rows and "targets" in rows:
+            mask = np.asarray(rows["mask"][sl]) > 0
+            correct += int(
+                (pred[mask] == np.asarray(rows["targets"][sl])[mask]).sum()
+            )
+            total += int(mask.sum())
+    return (
+        correct / max(total, 1) if total else 0.0,
+        np.concatenate(preds) if preds else np.zeros_like(toks),
+    )

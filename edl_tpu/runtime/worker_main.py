@@ -55,7 +55,6 @@ import sys
 import threading
 import time
 import dataclasses
-from dataclasses import dataclass
 from typing import Any, Callable, Dict, Optional
 
 import numpy as np
@@ -70,401 +69,18 @@ _POLL_S = 0.02
 
 
 # --------------------------------------------------------------------------
-# config
+# config: runtime/worker_config.py (re-exported: the EDL_* env contract)
 
+from edl_tpu.runtime.worker_config import WorkerConfig  # noqa: E402
 
-@dataclass
-class WorkerConfig:
-    job: str
-    worker_id: str
-    coord_host: str
-    coord_port: int
-    min_workers: int
-    max_workers: int
-    fault_tolerant: bool
-    model: str = "linreg"
-    # elastic mesh string (MeshPlan.parse): "dp" | "fsdp" | "fsdp,tp=2" …
-    # — one growth axis absorbs membership change, fixed axes survive it
-    mesh: str = "dp"
-    local_devices: int = 0  # >0: force an n-device virtual CPU platform
-    per_device_batch: int = 32
-    n_samples: int = 4096
-    passes: int = 1
-    lease_timeout_s: float = 16.0
-    member_ttl_s: float = 10.0
-    ckpt_dir: str = ""
-    # periodic sharded-checkpoint cadence in steps (0 = only at
-    # reshard/stop). REQUIRED for crash recovery on state no single
-    # process can snapshot (fsdp): a SIGKILL'd peer takes its primary
-    # shards with it, so survivors roll back to the last commit.
-    ckpt_every: int = 0
-    # how long the commit leader waits for every member's shard write
-    # before abandoning the manifest (size with shard bytes / storage
-    # bandwidth: multi-GB FSDP shards on shared storage need minutes)
-    ckpt_commit_timeout_s: float = 300.0
-    seed: int = 0
-    vocab: int = 4096  # ctr/llama hash/token space (small for tests)
-    emb: int = 0  # ctr embedding dim override (0 = model default)
-    seq_len: int = 64  # llama sequence length
-    # on-disk dataset (runtime/shards.py manifest dir, usually a mounted
-    # volume). When set, leased tasks read REAL rows from shard files
-    # instead of synthesizing them, and n_samples comes from the
-    # manifest (reference: pre-baked RecordIO shards,
-    # example/fit_a_line/Dockerfile:1-8).
-    data_dir: str = ""
-    rendezvous_timeout_s: float = 120.0
-    step_sleep_s: float = 0.0  # throttle (tests: keeps jobs scalable mid-run)
-    # servable export root: the commit leader writes a params-only,
-    # dtype-cast artifact at every checkpoint commit and at stop
-    # (reference save_inference_model, example/ctr/ctr/train.py:169-180)
-    export_dir: str = ""
-    export_dtype: str = "bfloat16"
-    # delayed-sync DP: K local steps per dp group between cross-group
-    # averages (trainer.LocalSyncStepper; the --async_mode analog,
-    # reference example/ctr/ctr/train.py:75-79). 1 = fully synchronous.
-    # Requires a dp-only mesh. Crash semantics: grouped state cannot be
-    # snapshotted across a membership change, so a SIGKILL'd peer rolls
-    # the job back to the last committed checkpoint (cadence:
-    # ckpt_every) — graceful reshards/stops merge first and lose nothing.
-    sync_every: int = 1
-    # peer-to-peer state redistribution (shard_server.py): workers serve
-    # their host-RAM snapshots over TCP; a reshard restores owner-
-    # changing shards worker-to-worker across the drain window instead
-    # of round-tripping through shared storage, and departing workers
-    # linger (bounded) until the new world confirms restore. The data
-    # plane for a migration to a DISJOINT worker set.
-    p2p: bool = True
-    p2p_linger_s: float = 20.0
-    # held-out eval split (runtime/shards.py dataset dir): the commit
-    # leader evaluates every published export against it and publishes
-    # eval_metric in KV — the AUC-in-the-train-loop analog (reference:
-    # example/ctr/ctr/train.py:161-167). Requires export_dir and a
-    # workload that defines eval_fn.
-    eval_dir: str = ""
-    # eval resource bounds (ADVICE r4): the held-out split is CAPPED
-    # (not the whole dir into leader RAM), and EDL_EVAL_DEVICE=cpu
-    # moves the forward passes off the accelerator so eval never
-    # contends with the training step loop for HBM.
-    eval_max_rows: int = 4096
-    eval_device: str = ""
-    # TPU slice this host belongs to (multi-slice topology). -1 =
-    # unknown: the mesh build falls back to the hardware's own
-    # ``device.slice_index`` (real multislice TPU exposes it). When set
-    # (launcher/controller placement, or GKE's MEGASCALE_SLICE_ID), the
-    # worker publishes it in coordinator KV so EVERY peer can order the
-    # global device list slice-major at reshard — dp/pp cross slices
-    # over DCN, fsdp/sp/ep/tp stay inside one slice's ICI
-    # (parallel/mesh.py MeshPlan.build slices=...).
-    slice_id: int = -1
-
-    @classmethod
-    def from_env(cls, env: Optional[Dict[str, str]] = None) -> "WorkerConfig":
-        e = dict(env if env is not None else os.environ)
-        host, port = (e.get("EDL_COORDINATOR") or "127.0.0.1:7164").rsplit(":", 1)
-        return cls(
-            job=e.get("EDL_JOB_NAME", "job"),
-            worker_id=e.get("EDL_WORKER_ID")
-            or e.get("HOSTNAME")
-            or f"w{os.getpid()}",
-            coord_host=host,
-            coord_port=int(port),
-            min_workers=int(e.get("EDL_WORKERS_MIN", e.get("EDL_WORKERS", "1"))),
-            max_workers=int(e.get("EDL_WORKERS_MAX", e.get("EDL_WORKERS", "1"))),
-            fault_tolerant=e.get("EDL_FAULT_TOLERANT", "0") == "1",
-            model=e.get("EDL_MODEL", "linreg"),
-            mesh=e.get("EDL_MESH", "dp"),
-            local_devices=int(e.get("EDL_LOCAL_DEVICES", "0")),
-            per_device_batch=int(e.get("EDL_PER_DEVICE_BATCH", "32")),
-            n_samples=int(e.get("EDL_NUM_SAMPLES", "4096")),
-            passes=int(e.get("EDL_NUM_PASSES", "1")),
-            lease_timeout_s=float(e.get("EDL_LEASE_TIMEOUT_S", "16")),
-            member_ttl_s=float(e.get("EDL_MEMBER_TTL_S", "10")),
-            ckpt_dir=e.get("EDL_CKPT_DIR", ""),
-            ckpt_every=int(e.get("EDL_CKPT_EVERY", "0")),
-            ckpt_commit_timeout_s=float(
-                e.get("EDL_CKPT_COMMIT_TIMEOUT_S", "300")
-            ),
-            seed=int(e.get("EDL_SEED", "0")),
-            vocab=int(e.get("EDL_VOCAB", "4096")),
-            emb=int(e.get("EDL_EMB", "0")),
-            seq_len=int(e.get("EDL_SEQ_LEN", "64")),
-            data_dir=e.get("EDL_DATA_DIR", ""),
-            rendezvous_timeout_s=float(e.get("EDL_RENDEZVOUS_TIMEOUT_S", "120")),
-            step_sleep_s=float(e.get("EDL_STEP_SLEEP_S", "0")),
-            sync_every=int(e.get("EDL_SYNC_EVERY", "1")),
-            export_dir=e.get("EDL_EXPORT_DIR", ""),
-            export_dtype=e.get("EDL_EXPORT_DTYPE", "bfloat16"),
-            p2p=e.get("EDL_P2P", "1") != "0",
-            p2p_linger_s=float(e.get("EDL_P2P_LINGER_S", "20")),
-            eval_dir=e.get("EDL_EVAL_DIR", ""),
-            eval_max_rows=int(e.get("EDL_EVAL_MAX_ROWS", "4096")),
-            eval_device=e.get("EDL_EVAL_DEVICE", ""),
-            # MEGASCALE_SLICE_ID is what GKE injects into multislice
-            # TPU pods — honoring it makes the kube path slice-aware
-            # with no manifest change
-            slice_id=int(
-                e.get("EDL_SLICE", e.get("MEGASCALE_SLICE_ID", "-1"))
-            ),
-        )
 
 
 # --------------------------------------------------------------------------
-# model registry — each entry builds a Workload: batch_fn(start, end)
-# synthesizes the samples of index range [start, end) deterministically,
-# so any worker can materialize any leased task (the RecordIO-shard
-# analog); pspecs(plan) returns model-specific parameter PartitionSpecs
-# (None = the generic fsdp rule of parallel/sharding.py).
+# model registry: runtime/workloads.py (re-exported for existing
+# consumers of the env contract)
 
+from edl_tpu.runtime.workloads import WORKLOADS, Workload  # noqa: E402
 
-@dataclass
-class Workload:
-    init_params: Callable[[], Any]
-    loss_fn: Callable
-    batch_fn: Callable[[int, int], Dict[str, np.ndarray]]
-    pspecs: Optional[Callable[[Any], Any]] = None
-    # mesh-aware loss factory (plan, mesh) -> loss_fn. Models whose
-    # program depends on the mesh layout (llama's sp ring attention /
-    # pp pipeline schedule) provide this; it is re-invoked after every
-    # rendezvous so the compiled step matches the current elastic mesh.
-    # When absent, the static loss_fn is used as-is.
-    make_loss: Optional[Callable[[Any, Any], Callable]] = None
-    # JSON-safe architecture record (e.g. LlamaConfig.to_meta()) that
-    # rides export manifests so a serving consumer can rebuild the
-    # model (CLI: `edl generate`)
-    model_meta: Optional[Dict[str, Any]] = None
-    # held-out evaluation ``f(params, rows) -> float`` run by the
-    # commit leader on every published export (cfg.eval_dir)
-    eval_fn: Optional[Callable[[Any, Dict[str, np.ndarray]], float]] = None
-
-    def loss_for(self, plan, mesh) -> Callable:
-        return self.make_loss(plan, mesh) if self.make_loss else self.loss_fn
-
-
-def _linreg_workload(cfg: WorkerConfig) -> Workload:
-    import jax
-
-    from edl_tpu.models import linreg
-
-    rng = np.random.RandomState(cfg.seed)
-    w_true = rng.randn(linreg.N_FEATURES, 1).astype(np.float32)
-
-    def batch_fn(start: int, end: int) -> Dict[str, np.ndarray]:
-        r = np.random.RandomState(cfg.seed * 1_000_003 + start + 1)
-        x = r.randn(end - start, linreg.N_FEATURES).astype(np.float32)
-        y = x @ w_true + 0.1 * r.randn(end - start, 1).astype(np.float32)
-        return {"x": x, "y": y}
-
-    def eval_rmse(params, rows):
-        pred = np.asarray(linreg.predict(params, rows["x"]))
-        return float(np.sqrt(np.mean((pred - rows["y"]) ** 2)))
-
-    return Workload(
-        lambda: linreg.init_params(jax.random.PRNGKey(cfg.seed)),
-        linreg.loss_fn,
-        batch_fn,
-        eval_fn=eval_rmse,
-    )
-
-
-def _ctr_workload(cfg: WorkerConfig) -> Workload:
-    import jax
-
-    from edl_tpu.models import ctr
-
-    def batch_fn(start: int, end: int) -> Dict[str, np.ndarray]:
-        r = np.random.RandomState(cfg.seed * 1_000_003 + start + 1)
-        return ctr.synthetic_batch(r, end - start, vocab=cfg.vocab)
-
-    def eval_auc(params, rows):
-        import jax.numpy as jnp
-
-        logits = ctr.forward(
-            params, jnp.asarray(rows["dense"]), jnp.asarray(rows["sparse"])
-        )
-        # the reference's in-train-loop metric (example/ctr/ctr/
-        # train.py:161-167): AUC over the held-out split
-        return float(
-            ctr.batch_auc(logits, jnp.asarray(rows["label"], jnp.float32))
-        )
-
-    emb_kw = {"emb": cfg.emb} if cfg.emb else {}
-    return Workload(
-        lambda: ctr.init_params(
-            jax.random.PRNGKey(cfg.seed), vocab=cfg.vocab, **emb_kw
-        ),
-        ctr.make_loss_fn(),
-        batch_fn,
-        eval_fn=eval_auc,
-        # architecture record so `edl predict` can score a CTR export
-        # offline — THE reference serving artifact
-        # (example/ctr/ctr/train.py:169-180). ctr.forward reads its
-        # architecture from the params themselves; the record is the
-        # family dispatch + provenance.
-        model_meta={
-            "family": "ctr",
-            "vocab": cfg.vocab,
-            "emb": cfg.emb or ctr.DEFAULT_EMBEDDING,
-            "mlp_dims": list(ctr.MLP_DIMS),
-        },
-    )
-
-
-_EVAL_CHUNK = 64  # rows per forward in held-out evals: LM heads emit
-# [rows, T, vocab] f32 logits — one unchunked call over a real split
-# would OOM the commit leader
-
-
-def _lm_ppl_eval(logits_fn):
-    """Chunked next-token perplexity over {tokens [N, T+1]} — shared by
-    the llama/moe workloads (only the forward differs); CE accumulates
-    per row slice so no [N, T, vocab] tensor ever materializes."""
-
-    def eval_ppl(params, rows):
-        import jax.numpy as jnp
-        import optax
-
-        toks = np.asarray(rows["tokens"])
-        total, count = 0.0, 0
-        for s in range(0, len(toks), _EVAL_CHUNK):
-            t = jnp.asarray(toks[s : s + _EVAL_CHUNK])
-            logits = logits_fn(params, t[:, :-1])
-            ce = optax.softmax_cross_entropy_with_integer_labels(
-                logits, t[:, 1:]
-            )
-            total += float(jnp.sum(ce))
-            count += ce.size
-        return float(np.exp(total / max(count, 1)))
-
-    return eval_ppl
-
-
-def _llama_workload(cfg: WorkerConfig) -> Workload:
-    """The flagship: Llama decoder under elastic FSDP(×TP) — BASELINE
-    config #5 ("Llama-3-8B elastic FSDP across growing TPU slice") at
-    the configured scale (tests: LlamaConfig.tiny)."""
-    import jax
-
-    from edl_tpu.models import llama
-
-    mcfg = llama.LlamaConfig.tiny(vocab=cfg.vocab)
-
-    def batch_fn(start: int, end: int) -> Dict[str, np.ndarray]:
-        r = np.random.RandomState(cfg.seed * 1_000_003 + start + 1)
-        return llama.synthetic_tokens(r, end - start, cfg.seq_len, cfg.vocab)
-
-    return Workload(
-        lambda: llama.init_params(jax.random.PRNGKey(cfg.seed), mcfg),
-        llama.make_loss_fn(mcfg),
-        batch_fn,
-        pspecs=lambda plan: llama.param_pspecs(mcfg, plan),
-        # sp/pp are mesh-layout-dependent (ring attention shard_map /
-        # GPipe schedule) — rebuild the loss per rendezvous
-        make_loss=lambda plan, mesh: llama.make_loss_fn(mcfg, plan, mesh),
-        model_meta=mcfg.to_meta(),
-        eval_fn=_lm_ppl_eval(lambda p, t: llama.forward(p, t, mcfg)),
-    )
-
-
-def _bert_workload(cfg: WorkerConfig) -> Workload:
-    """BERT-class MLM pretraining under elastic DP with checkpoint
-    reshard (BASELINE config #4: "ERNIE / BERT-base pretraining")."""
-    import jax
-
-    from edl_tpu.models import bert
-
-    mcfg = bert.BertConfig.tiny(vocab=cfg.vocab)
-
-    def batch_fn(start: int, end: int) -> Dict[str, np.ndarray]:
-        r = np.random.RandomState(cfg.seed * 1_000_003 + start + 1)
-        return bert.synthetic_mlm_batch(r, end - start, cfg.seq_len, cfg.vocab)
-
-    def eval_mlm_acc(params, rows):
-        import jax.numpy as jnp
-
-        # masked-token top-1 accuracy, chunked (vocab-sized logits)
-        correct = total = 0
-        toks = np.asarray(rows["tokens"])
-        for s in range(0, len(toks), _EVAL_CHUNK):
-            sl = slice(s, s + _EVAL_CHUNK)
-            logits = bert.forward(params, jnp.asarray(toks[sl]), mcfg)
-            pred = np.asarray(jnp.argmax(logits, -1))
-            mask = rows["mask"][sl] > 0
-            correct += int((pred[mask] == rows["targets"][sl][mask]).sum())
-            total += int(mask.sum())
-        return correct / max(total, 1)
-
-    return Workload(
-        lambda: bert.init_params(jax.random.PRNGKey(cfg.seed), mcfg),
-        bert.make_loss_fn(mcfg),
-        batch_fn,
-        pspecs=lambda plan: bert.param_pspecs(mcfg, plan),
-        model_meta=mcfg.to_meta(),
-        eval_fn=eval_mlm_acc,
-    )
-
-
-def _resnet_workload(cfg: WorkerConfig) -> Workload:
-    """ResNet-class image classification under elastic all-reduce DP
-    (BASELINE config #3: "ResNet-50 ImageNet, elastic all-reduce DP")."""
-    import jax
-
-    from edl_tpu.models import resnet
-
-    mcfg = resnet.ResNetConfig.tiny()
-
-    def batch_fn(start: int, end: int) -> Dict[str, np.ndarray]:
-        r = np.random.RandomState(cfg.seed * 1_000_003 + start + 1)
-        return resnet.synthetic_batch(r, end - start)
-
-    def eval_top1(params, rows):
-        import jax.numpy as jnp
-
-        logits = resnet.forward(params, jnp.asarray(rows["images"]), mcfg)
-        pred = np.asarray(jnp.argmax(logits, -1))
-        return float((pred == rows["label"]).mean())
-
-    return Workload(
-        lambda: resnet.init_params(jax.random.PRNGKey(cfg.seed), mcfg),
-        resnet.make_loss_fn(mcfg),
-        batch_fn,
-        pspecs=lambda plan: resnet.param_pspecs(mcfg, plan),
-        model_meta=mcfg.to_meta(),
-        eval_fn=eval_top1,
-    )
-
-
-def _moe_workload(cfg: WorkerConfig) -> Workload:
-    """Mixture-of-Experts decoder under elastic DPxEP (no reference
-    analog — SURVEY §2.5 "Expert parallelism: NO"; mesh "ep=2,dp"
-    pins the expert axis while dp absorbs membership change)."""
-    import jax
-
-    from edl_tpu.models import moe
-
-    mcfg = moe.MoEConfig.tiny(vocab=cfg.vocab)
-
-    def batch_fn(start: int, end: int) -> Dict[str, np.ndarray]:
-        r = np.random.RandomState(cfg.seed * 1_000_003 + start + 1)
-        return moe.synthetic_tokens(r, end - start, cfg.seq_len, cfg.vocab)
-
-    return Workload(
-        lambda: moe.init_params(jax.random.PRNGKey(cfg.seed), mcfg),
-        moe.make_loss_fn(mcfg),
-        batch_fn,
-        pspecs=lambda plan: moe.param_pspecs(mcfg, plan),
-        model_meta=mcfg.to_meta(),
-        eval_fn=_lm_ppl_eval(lambda p, t: moe.forward(p, t, mcfg)[0]),
-    )
-
-
-WORKLOADS: Dict[str, Callable[[WorkerConfig], Workload]] = {
-    "linreg": _linreg_workload,
-    "ctr": _ctr_workload,
-    "llama": _llama_workload,
-    "bert": _bert_workload,
-    "resnet": _resnet_workload,
-    "moe": _moe_workload,
-}
 
 
 # --------------------------------------------------------------------------
@@ -563,24 +179,6 @@ def _clear_backends() -> None:
         jax.extend.backend.clear_backends()
 
 
-_VETO_TTL_EPOCHS = 4
-
-
-def _veto_active(raw: Optional[str], epoch: int) -> bool:
-    """Whether a per-step p2p veto KV value (the epoch it was written)
-    is still in force. One key PER STEP, written blindly on failure:
-    writes for different steps never race each other, so no veto can be
-    lost to a read-modify-write interleaving (a single set-valued key
-    would let a straggler's stale write resurrect a doomed step).
-    Malformed values read as expired rather than wedging the decision."""
-    if not raw:
-        return False
-    try:
-        return epoch - int(raw) <= _VETO_TTL_EPOCHS
-    except ValueError:
-        return False
-
-
 # --------------------------------------------------------------------------
 # the worker
 
@@ -600,20 +198,23 @@ class ElasticWorker:
         self._model_meta = None  # architecture record for exports
         # epoch-scoped KV (go/dist/disc keys) retired by past epochs,
         # GC'd one epoch later — keeps the coordinator KV (and its WAL
-        # snapshots) O(live state), not O(job epochs). dist_done marks
-        # go through _gc_later (an extra epoch of delay): the detached
-        # service host polls them every 0.5 s and normally deletes its
-        # own, so the worker only sweeps up after a crashed host — and
-        # must not win a race against a live host's dismissal poll.
-        self._gc_keys: list = []
-        self._gc_later: list = []
-        self._shard_server = None  # p2p shard service (run())
-        self._p2p_token = None  # per-job shard-plane auth (run())
+        # snapshots) O(live state), not O(job epochs). The two-phase
+        # deferral semantics (and which keys MUST take the late lane)
+        # live in runtime/epoch_gc.py.
+        from edl_tpu.runtime.epoch_gc import EpochKeyGC
+        from edl_tpu.runtime.eval_hook import ExportEvaluator
+        from edl_tpu.runtime.p2p_restore import P2PRestorePlane
+
+        self._gc = EpochKeyGC()
+        # p2p shard plane brokering (server lifecycle, roster, restore
+        # decision, veto, drain-window linger): runtime/p2p_restore.py
+        self._p2p = P2PRestorePlane(
+            cfg, self._k, self._gc, lambda: self._ram_snapshot
+        )
+        # commit-leader held-out eval: runtime/eval_hook.py
+        self._eval = ExportEvaluator(cfg, self._k)
         self._incarnation = 0  # set at bootstrap; bumped to force regroup
         self._restore_failures = 0
-        self._eval_fn = None  # workload eval hook (run(), cfg.eval_dir)
-        self._eval_rows = None  # held-out split, loaded once (capped)
-        self._eval_failures = 0  # consecutive eval failures (KV-surfaced)
 
     # -- keys ----------------------------------------------------------------
     def _k(self, *parts: str) -> str:
@@ -742,8 +343,9 @@ class ElasticWorker:
             ckpt.latest_manifest(self.cfg.ckpt_dir) if self.cfg.ckpt_dir else None
         )
         if self.cfg.p2p and cl is not None:
-            state = self._p2p_restore(
-                cl, epoch, rank, members, like, state_sh, manifest
+            state = self._p2p.restore(
+                cl, epoch, rank, members, like, state_sh, manifest,
+                self._ram_snapshot,
             )
             if state is not None:
                 return state, pspecs
@@ -776,239 +378,6 @@ class ElasticWorker:
             )()
         return state, pspecs
 
-    # -- P2P reshard data plane ----------------------------------------------
-
-    def _merge_shardsrv_roster(self, cl, members) -> list:
-        """Rank 0 unions the current members into the job's shard-server
-        roster (single writer per epoch: no read-modify-write races).
-        Departed workers stay listed while recent — exactly the window
-        in which a migration needs to find their lingering servers —
-        and age out of the 16-name cap."""
-        import json as _json
-
-        names = _json.loads(cl.kv_get(self._k("shardsrv_names")) or "[]")
-        for m in members:
-            if m.name in names:
-                names.remove(m.name)  # refresh recency
-            names.append(m.name)
-        # cap covers every CURRENT member (they sit at the tail, so the
-        # cap can never age out a live worker's only addr publication)
-        cap = max(16, len(members))
-        for dropped in names[:-cap]:  # GC aged-out workers' addr keys
-            cl.kv_del(self._k("shardsrv", dropped))
-        names = names[-cap:]
-        cl.kv_put(self._k("shardsrv_names"), _json.dumps(names))
-        return names
-
-    def _probe_peers(self, cl):
-        """{name: (addr, step, entries)} for every reachable shard
-        server on the roster except our own. Probes run in parallel —
-        dead entries cost one bounded connect timeout, not a serial
-        scan."""
-        import json as _json
-
-        from edl_tpu.runtime.shard_server import fetch_index
-
-        names = _json.loads(cl.kv_get(self._k("shardsrv_names")) or "[]")
-        out: Dict[str, Any] = {}
-        lock = threading.Lock()
-
-        def probe(name, addr):
-            got = fetch_index(addr, timeout_s=1.0, token=self._p2p_token)
-            if got is not None and got[0] >= 0:
-                with lock:
-                    out[name] = (addr, got[0], got[1])
-
-        threads = []
-        for name in names:
-            if name == self.cfg.worker_id:
-                continue
-            addr = cl.kv_get(self._k("shardsrv", name))
-            if not addr:
-                continue
-            t = threading.Thread(target=probe, args=(name, addr), daemon=True)
-            t.start()
-            threads.append(t)
-        for t in threads:
-            t.join(5.0)
-        with lock:
-            # a straggler thread (slow peer past the bounded join) must
-            # not mutate the dict the caller is iterating
-            return dict(out)
-
-    def _p2p_restore(self, cl, epoch, rank, members, like, state_sh, manifest):
-        """Restore from peers' RAM snapshots over the drain window
-        (VERDICT r3 #5). Rank 0 probes the roster, picks the NEWEST
-        step whose pieces (peers + its own RAM) tile the full state and
-        is at least as new as the committed manifest, and publishes the
-        decision; everyone assembles that step from own-RAM + manifest
-        (same step) + lazily-fetched peer pieces. Returns None when the
-        decision is to use disk/fresh (callers fall through)."""
-        from edl_tpu.runtime import checkpoint as ckpt
-        from edl_tpu.runtime.shard_server import RemotePieces
-
-        # converge on the job token (a cold-start write race can leave
-        # an early worker holding the losing value; KV is the truth)
-        self._p2p_token = cl.kv_get(self._k("p2p_token")) or self._p2p_token
-        dkey = self._k("restore", str(epoch))
-        peers = None
-        if rank == 0:
-            self._merge_shardsrv_roster(cl, members)
-            peers = self._probe_peers(cl)
-            own = self._ram_snapshot
-            m_step = int(manifest["step"]) if manifest is not None else -1
-            cand = sorted(
-                {s for (_, s, _) in peers.values()}
-                | ({own.step} if own is not None else set()),
-                reverse=True,
-            )
-            # a worker that failed ASSEMBLING a p2p step (peer advertised
-            # pieces but fetches failed) vetoes that step for a few
-            # epochs — otherwise a deterministic decision re-picks the
-            # doomed step every regroup until the failure abort, even
-            # though the manifest fallback was available (ADVICE r4).
-            # One KV key per vetoed step (see _veto_active): vetoes for
-            # different steps can neither ping-pong a shared slot nor
-            # lose each other to concurrent read-modify-writes.
-            decision = "none"
-            for s in cand:
-                if s < m_step:
-                    break  # never restore older than the committed truth
-                # NO GC delete of expired veto keys here: a read-then-
-                # delete could race a straggler's fresh blind write and
-                # erase an ACTIVE veto. The keys are a few bytes each
-                # and only exist for steps whose restore actually
-                # failed — boundedness comes from rarity, not reaping.
-                if _veto_active(cl.kv_get(self._k("p2p_veto", str(s))), epoch):
-                    continue
-                entries = [
-                    e
-                    for (_, ps, es) in peers.values()
-                    if ps == s
-                    for e in es
-                ]
-                if own is not None and own.step == s:
-                    entries += [
-                        ckpt._piece_key(k, o, tuple(a.shape))
-                        for k, plist in own.pieces.items()
-                        for o, a in plist
-                    ]
-                if ckpt.peer_coverage_ok(like, entries):
-                    decision = f"p2p:{s}"
-                    break
-            cl.kv_put(dkey, decision)
-        else:
-            deadline = time.monotonic() + self.cfg.rendezvous_timeout_s
-            rank0 = next((m.name for m in members if m.rank == 0), None)
-            decision = cl.kv_get(dkey)
-            while decision is None:
-                # bail fast instead of burning the whole rendezvous
-                # timeout: a DEAD rank 0 can never publish (same rule
-                # as _await_go), and an epoch bump means the group is
-                # regrouping anyway — unlike a step verb, an unpublished
-                # RESTORE decision cannot have a collective in flight,
-                # so abandoning it strands nobody
-                cl.expire()
-                if rank0 not in {m.name for m in cl.members()}:
-                    raise RuntimeError(
-                        "rank-0 worker died before the restore decision"
-                    )
-                if cl.epoch() != epoch:
-                    raise RuntimeError(
-                        "membership moved before the restore decision"
-                    )
-                if time.monotonic() > deadline:
-                    raise TimeoutError("no restore decision from rank 0")
-                time.sleep(_POLL_S)
-                decision = cl.kv_get(dkey)
-        # GC one epoch LATE (_gc_later): rank 0 reaches the next GC
-        # point while same-epoch peers may still be polling this key —
-        # deleting it now would strand them for the full timeout
-        self._gc_later.append(dkey)
-        # observability (tests/monitor): how the LAST restore happened
-        if rank == 0:
-            cl.kv_put(self._k("restore_last"), decision)
-        if not decision.startswith("p2p:"):
-            return None
-        step = int(decision[4:])
-        if peers is None:
-            peers = self._probe_peers(cl)
-        remotes = [
-            RemotePieces(addr, entries, token=self._p2p_token)
-            for (addr, s, entries) in peers.values()
-            if s == step
-        ]
-        try:
-            state = ckpt.load_from_pieces(
-                step, like, state_sh,
-                ram=self._ram_snapshot,
-                manifest=manifest,
-                remotes=remotes,
-            )
-        except Exception:
-            # veto this step so the regroup's next decision falls
-            # through to the manifest instead of re-picking it (the
-            # veto key is NOT epoch-scoped: it must outlive this epoch;
-            # one key per step — a blind, raceless write)
-            try:
-                cl.kv_put(self._k("p2p_veto", str(step)), str(epoch))
-            except Exception:
-                pass
-            raise
-        finally:
-            for r in remotes:
-                r.close()
-        log.info("restored via p2p", step=step, peers=len(remotes))
-        return state
-
-    def _eval_export(self, client, step: int) -> None:
-        """Held-out evaluation on every published export (the leader,
-        host-side, behind the step loop): reference parity for AUC
-        fetched in the train loop (example/ctr/ctr/train.py:161-167).
-        Needs cfg.eval_dir (a runtime/shards.py dataset) and a workload
-        eval_fn; publishes ``eval_metric`` = "<step>:<value>" in KV for
-        the monitor/CLI and logs it."""
-        cfg = self.cfg
-        if not cfg.eval_dir or self._eval_fn is None:
-            return
-        try:
-            import contextlib
-
-            from edl_tpu.runtime.export import load_export
-            from edl_tpu.runtime.shards import FileShardSource
-
-            if self._eval_rows is None:
-                src = FileShardSource(cfg.eval_dir)
-                # cap, don't slurp: the split lives in leader host RAM
-                # for the job's lifetime (ADVICE r4)
-                self._eval_rows = src.fetch_range(
-                    0, min(src.n_samples, cfg.eval_max_rows)
-                )
-            params, _ = load_export(cfg.export_dir)
-            ctx = contextlib.nullcontext()
-            if cfg.eval_device == "cpu":
-                # off the accelerator: eval forwards must not contend
-                # with the training step loop for HBM
-                import jax
-
-                ctx = jax.default_device(jax.devices("cpu")[0])
-            with ctx:
-                metric = float(self._eval_fn(params, self._eval_rows))
-            client.kv_put(self._k("eval_metric"), f"{step}:{metric:.6f}")
-            log.info("eval", step=step, metric=round(metric, 6))
-            self._eval_failures = 0
-        except Exception as e:  # pragma: no cover - eval is best-effort
-            # best-effort, but NOT silent: repeated failures (e.g. the
-            # eval OOMing the leader every commit) surface in KV where
-            # the monitor/CLI can see them, not just a local log line
-            self._eval_failures += 1
-            try:
-                client.kv_put(
-                    self._k("eval_failures"), str(self._eval_failures)
-                )
-            except Exception:
-                pass
-            log.warn("export eval failed", error=str(e))
 
     def _join_pending_commit(self) -> None:
         """At most ONE background commit is in flight; the next commit,
@@ -1151,7 +520,7 @@ class ElasticWorker:
                                     dir=d,
                                     step=snap.step,
                                 )
-                                self._eval_export(client, snap.step)
+                                self._eval.evaluate(client, snap.step)
                         except Exception as e:  # pragma: no cover
                             log.error("export failed", error=str(e))
                 else:  # pragma: no cover - crash-timing path
@@ -1247,8 +616,8 @@ class ElasticWorker:
 
         wl = WORKLOADS[cfg.model](cfg)
         self._model_meta = wl.model_meta
-        self._eval_fn = wl.eval_fn
-        if cfg.eval_dir and self._eval_fn is None:
+        self._eval.eval_fn = wl.eval_fn
+        if cfg.eval_dir and wl.eval_fn is None:
             # surface the misconfiguration once: otherwise EDL_EVAL_DIR
             # on a workload without an eval hook is a silent no-op
             log.warn(
@@ -1277,34 +646,11 @@ class ElasticWorker:
             self.client.kv_put(
                 self._k("slice", cfg.worker_id), str(cfg.slice_id)
             )
-        if cfg.p2p:
-            # serve our host-RAM snapshot to peers (P2P reshard data
-            # plane); published before registration like the slice id.
-            # EDL_HOST_ADDR is the reachable address of this host
-            # (pod IP in production; loopback for local jobs).
-            from edl_tpu.runtime.shard_server import ShardServer
-
-            # per-job token gates the weight plane (ADVICE r4): first
-            # worker to look writes one; everyone converges on the KV
-            # value (re-read after write — last write wins for all)
-            tok = self.client.kv_get(self._k("p2p_token"))
-            if not tok:
-                import secrets
-
-                self.client.kv_put(
-                    self._k("p2p_token"), secrets.token_hex(16)
-                )
-                tok = self.client.kv_get(self._k("p2p_token"))
-            self._p2p_token = tok
-            self._shard_server = ShardServer(
-                lambda: self._ram_snapshot,
-                check_token=lambda t: bool(t) and t == self._p2p_token,
-            )
-            self.client.kv_put(
-                self._k("shardsrv", cfg.worker_id),
-                f"{os.environ.get('EDL_HOST_ADDR', '127.0.0.1')}:"
-                f"{self._shard_server.port}",
-            )
+        # serve our host-RAM snapshot to peers (P2P reshard data plane);
+        # published before registration like the slice id. Server
+        # lifecycle, token, roster, and restore brokering:
+        # runtime/p2p_restore.py.
+        self._p2p.start(self.client)
         ctx = entrypoint.bootstrap(self.client)
         self._incarnation = ctx.incarnation
         heartbeat_stop = self._start_heartbeat(ctx.incarnation)
@@ -1380,7 +726,7 @@ class ElasticWorker:
                     cl.kv_put(self._dist_done_key(epoch, addr), "1")
                     # a live host deletes its own mark; sweep up after a
                     # dead one so failed inits don't leak KV forever
-                    self._gc_later.append(self._dist_done_key(epoch, addr))
+                    self._gc.defer_late(self._dist_done_key(epoch, addr))
                 init_failures += 1
                 if init_failures >= 5:
                     raise RuntimeError(
@@ -1465,9 +811,9 @@ class ElasticWorker:
             # still mid-fetch (connection reset, failed epoch).
             rmark = lambda n: self._k("restored", str(epoch), n)  # noqa: E731
             cl.kv_put(rmark(cfg.worker_id), "1")
-            # _gc_later, NOT _gc_keys: this epoch's own GC drain runs
-            # before rank 0 finishes collecting the marks
-            self._gc_later.append(rmark(cfg.worker_id))
+            # the LATE lane, not defer(): this epoch's own GC drain runs
+            # before rank 0 finishes collecting the marks (epoch_gc.py)
+            self._gc.defer_late(rmark(cfg.worker_id))
             if rank == 0:
                 deadline = time.monotonic() + cfg.rendezvous_timeout_s
                 confirmed = False
@@ -1510,13 +856,11 @@ class ElasticWorker:
             # every member has connected to this epoch's service, which
             # it only does after finishing the previous epoch's
             # teardown — nobody still reads those keys. EVERY worker
-            # drains its own list (deletes are idempotent across
+            # drains its own ledger (deletes are idempotent across
             # peers), so the keys go away even when rank 0 is a
-            # freshly restarted process with no history.
-            for k in self._gc_keys:
-                cl.kv_del(k)
-            self._gc_keys = self._gc_later
-            self._gc_later = []
+            # freshly restarted process with no history. The two-lane
+            # deferral semantics: runtime/epoch_gc.py.
+            self._gc.drain(cl.kv_del)
             if rank == 0:
                 self._ensure_queue(cl)
             outcome = self._train_epoch(
@@ -1791,11 +1135,12 @@ class ElasticWorker:
         # retire this epoch's coordination keys at the NEXT rendezvous
         # (they must survive until every peer has left the epoch; the
         # dist_done mark must outlive the service host's dismissal poll)
-        self._gc_keys += (
-            [self._k("go", str(epoch)), self._k("dist", str(epoch))]
-            + [disc(m.name) for m in members]
+        self._gc.defer(
+            self._k("go", str(epoch)),
+            self._k("dist", str(epoch)),
+            *[disc(m.name) for m in members],
         )
-        self._gc_later.append(self._dist_done_key(epoch, addr))
+        self._gc.defer_late(self._dist_done_key(epoch, addr))
         cl.expire()
         alive = {m.name for m in cl.members()}
         leader = min(
@@ -1831,35 +1176,8 @@ class ElasticWorker:
         cl.release_worker(self.cfg.worker_id)
         cl.leave(self.cfg.worker_id)
         cl.kv_del(self._k("leaving", self.cfg.worker_id))
-        self._linger_for_migration(cl)
+        self._p2p.linger(cl)
         return code
-
-    def _linger_for_migration(self, cl) -> None:
-        """Drain-window P2P: after deregistering (so the new epoch can
-        form), keep the process alive serving our RAM snapshot until the
-        new world confirms it restored a step >= ours — the data plane
-        of a migration to a disjoint worker set. Bounded by
-        p2p_linger_s, extended while a peer is actively fetching."""
-        snap = self._ram_snapshot
-        srv = self._shard_server
-        if not self.cfg.p2p or snap is None or srv is None:
-            return
-        deadline = time.monotonic() + self.cfg.p2p_linger_s
-        while True:
-            try:
-                restored = int(cl.kv_get(self._k("restored_step")) or "-1")
-            except Exception:
-                return  # coordinator gone: the job is over
-            if restored >= snap.step:
-                return
-            if time.monotonic() > deadline and srv.active == 0:
-                log.warn(
-                    "departing without restore confirmation",
-                    snapshot_step=snap.step,
-                    restored_step=restored,
-                )
-                return
-            time.sleep(0.1)
 
 
 def main(argv=None) -> int:

@@ -1,0 +1,266 @@
+"""Workload registry — each built-in model family as an elastic-worker
+workload (extracted from worker_main per VERDICT r4 #4).
+
+Each entry builds a :class:`Workload`: ``batch_fn(start, end)``
+synthesizes the samples of index range [start, end) deterministically,
+so any worker can materialize any leased task (the RecordIO-shard
+analog); ``pspecs(plan)`` returns model-specific parameter
+PartitionSpecs (None = the generic fsdp rule of parallel/sharding.py);
+``eval_fn(params, rows)`` is the held-out metric the commit leader
+publishes (runtime/eval_hook.py); ``model_meta`` is the architecture
+record exports carry for serving consumers (runtime/predict.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from edl_tpu.runtime.worker_config import WorkerConfig
+
+# --------------------------------------------------------------------------
+# model registry — each entry builds a Workload: batch_fn(start, end)
+# synthesizes the samples of index range [start, end) deterministically,
+# so any worker can materialize any leased task (the RecordIO-shard
+# analog); pspecs(plan) returns model-specific parameter PartitionSpecs
+# (None = the generic fsdp rule of parallel/sharding.py).
+
+
+@dataclass
+class Workload:
+    init_params: Callable[[], Any]
+    loss_fn: Callable
+    batch_fn: Callable[[int, int], Dict[str, np.ndarray]]
+    pspecs: Optional[Callable[[Any], Any]] = None
+    # mesh-aware loss factory (plan, mesh) -> loss_fn. Models whose
+    # program depends on the mesh layout (llama's sp ring attention /
+    # pp pipeline schedule) provide this; it is re-invoked after every
+    # rendezvous so the compiled step matches the current elastic mesh.
+    # When absent, the static loss_fn is used as-is.
+    make_loss: Optional[Callable[[Any, Any], Callable]] = None
+    # JSON-safe architecture record (e.g. LlamaConfig.to_meta()) that
+    # rides export manifests so a serving consumer can rebuild the
+    # model (CLI: `edl generate`)
+    model_meta: Optional[Dict[str, Any]] = None
+    # held-out evaluation ``f(params, rows) -> float`` run by the
+    # commit leader on every published export (cfg.eval_dir)
+    eval_fn: Optional[Callable[[Any, Dict[str, np.ndarray]], float]] = None
+
+    def loss_for(self, plan, mesh) -> Callable:
+        return self.make_loss(plan, mesh) if self.make_loss else self.loss_fn
+
+
+def _linreg_workload(cfg: WorkerConfig) -> Workload:
+    import jax
+
+    from edl_tpu.models import linreg
+
+    rng = np.random.RandomState(cfg.seed)
+    w_true = rng.randn(linreg.N_FEATURES, 1).astype(np.float32)
+
+    def batch_fn(start: int, end: int) -> Dict[str, np.ndarray]:
+        r = np.random.RandomState(cfg.seed * 1_000_003 + start + 1)
+        x = r.randn(end - start, linreg.N_FEATURES).astype(np.float32)
+        y = x @ w_true + 0.1 * r.randn(end - start, 1).astype(np.float32)
+        return {"x": x, "y": y}
+
+    def eval_rmse(params, rows):
+        pred = np.asarray(linreg.predict(params, rows["x"]))
+        return float(np.sqrt(np.mean((pred - rows["y"]) ** 2)))
+
+    return Workload(
+        lambda: linreg.init_params(jax.random.PRNGKey(cfg.seed)),
+        linreg.loss_fn,
+        batch_fn,
+        eval_fn=eval_rmse,
+    )
+
+
+def _ctr_workload(cfg: WorkerConfig) -> Workload:
+    import jax
+
+    from edl_tpu.models import ctr
+
+    def batch_fn(start: int, end: int) -> Dict[str, np.ndarray]:
+        r = np.random.RandomState(cfg.seed * 1_000_003 + start + 1)
+        return ctr.synthetic_batch(r, end - start, vocab=cfg.vocab)
+
+    def eval_auc(params, rows):
+        import jax.numpy as jnp
+
+        logits = ctr.forward(
+            params, jnp.asarray(rows["dense"]), jnp.asarray(rows["sparse"])
+        )
+        # the reference's in-train-loop metric (example/ctr/ctr/
+        # train.py:161-167): AUC over the held-out split
+        return float(
+            ctr.batch_auc(logits, jnp.asarray(rows["label"], jnp.float32))
+        )
+
+    emb_kw = {"emb": cfg.emb} if cfg.emb else {}
+    return Workload(
+        lambda: ctr.init_params(
+            jax.random.PRNGKey(cfg.seed), vocab=cfg.vocab, **emb_kw
+        ),
+        ctr.make_loss_fn(),
+        batch_fn,
+        eval_fn=eval_auc,
+        # architecture record so `edl predict` can score a CTR export
+        # offline — THE reference serving artifact
+        # (example/ctr/ctr/train.py:169-180). ctr.forward reads its
+        # architecture from the params themselves; the record is the
+        # family dispatch + provenance.
+        model_meta={
+            "family": "ctr",
+            "vocab": cfg.vocab,
+            "emb": cfg.emb or ctr.DEFAULT_EMBEDDING,
+            "mlp_dims": list(ctr.MLP_DIMS),
+        },
+    )
+
+
+_EVAL_CHUNK = 64  # rows per forward in held-out evals: LM heads emit
+# [rows, T, vocab] f32 logits — one unchunked call over a real split
+# would OOM the commit leader
+
+
+def _lm_ppl_eval(logits_fn):
+    """Chunked next-token perplexity over {tokens [N, T+1]} — shared by
+    the llama/moe workloads (only the forward differs). The chunking/CE
+    math itself lives in models/evals.py, the SAME implementation
+    `edl predict` scores with — in-job eval_metric and an offline
+    re-score of one export cannot diverge."""
+
+    def eval_ppl(params, rows):
+        from edl_tpu.models.evals import lm_ppl
+
+        return lm_ppl(logits_fn, params, rows["tokens"], chunk=_EVAL_CHUNK)
+
+    return eval_ppl
+
+
+def _llama_workload(cfg: WorkerConfig) -> Workload:
+    """The flagship: Llama decoder under elastic FSDP(×TP) — BASELINE
+    config #5 ("Llama-3-8B elastic FSDP across growing TPU slice") at
+    the configured scale (tests: LlamaConfig.tiny)."""
+    import jax
+
+    from edl_tpu.models import llama
+
+    mcfg = llama.LlamaConfig.tiny(vocab=cfg.vocab)
+
+    def batch_fn(start: int, end: int) -> Dict[str, np.ndarray]:
+        r = np.random.RandomState(cfg.seed * 1_000_003 + start + 1)
+        return llama.synthetic_tokens(r, end - start, cfg.seq_len, cfg.vocab)
+
+    return Workload(
+        lambda: llama.init_params(jax.random.PRNGKey(cfg.seed), mcfg),
+        llama.make_loss_fn(mcfg),
+        batch_fn,
+        pspecs=lambda plan: llama.param_pspecs(mcfg, plan),
+        # sp/pp are mesh-layout-dependent (ring attention shard_map /
+        # GPipe schedule) — rebuild the loss per rendezvous
+        make_loss=lambda plan, mesh: llama.make_loss_fn(mcfg, plan, mesh),
+        model_meta=mcfg.to_meta(),
+        eval_fn=_lm_ppl_eval(lambda p, t: llama.forward(p, t, mcfg)),
+    )
+
+
+def _bert_workload(cfg: WorkerConfig) -> Workload:
+    """BERT-class MLM pretraining under elastic DP with checkpoint
+    reshard (BASELINE config #4: "ERNIE / BERT-base pretraining")."""
+    import jax
+
+    from edl_tpu.models import bert
+
+    mcfg = bert.BertConfig.tiny(vocab=cfg.vocab)
+
+    def batch_fn(start: int, end: int) -> Dict[str, np.ndarray]:
+        r = np.random.RandomState(cfg.seed * 1_000_003 + start + 1)
+        return bert.synthetic_mlm_batch(r, end - start, cfg.seq_len, cfg.vocab)
+
+    def eval_mlm_acc(params, rows):
+        # masked-token top-1 accuracy — the shared chunked
+        # implementation `edl predict` also scores with (models/evals)
+        from edl_tpu.models.evals import masked_top1
+
+        acc, _ = masked_top1(
+            lambda p, t: bert.forward(p, t, mcfg), params, rows,
+            chunk=_EVAL_CHUNK,
+        )
+        return acc
+
+    return Workload(
+        lambda: bert.init_params(jax.random.PRNGKey(cfg.seed), mcfg),
+        bert.make_loss_fn(mcfg),
+        batch_fn,
+        pspecs=lambda plan: bert.param_pspecs(mcfg, plan),
+        model_meta=mcfg.to_meta(),
+        eval_fn=eval_mlm_acc,
+    )
+
+
+def _resnet_workload(cfg: WorkerConfig) -> Workload:
+    """ResNet-class image classification under elastic all-reduce DP
+    (BASELINE config #3: "ResNet-50 ImageNet, elastic all-reduce DP")."""
+    import jax
+
+    from edl_tpu.models import resnet
+
+    mcfg = resnet.ResNetConfig.tiny()
+
+    def batch_fn(start: int, end: int) -> Dict[str, np.ndarray]:
+        r = np.random.RandomState(cfg.seed * 1_000_003 + start + 1)
+        return resnet.synthetic_batch(r, end - start)
+
+    def eval_top1(params, rows):
+        import jax.numpy as jnp
+
+        logits = resnet.forward(params, jnp.asarray(rows["images"]), mcfg)
+        pred = np.asarray(jnp.argmax(logits, -1))
+        return float((pred == rows["label"]).mean())
+
+    return Workload(
+        lambda: resnet.init_params(jax.random.PRNGKey(cfg.seed), mcfg),
+        resnet.make_loss_fn(mcfg),
+        batch_fn,
+        pspecs=lambda plan: resnet.param_pspecs(mcfg, plan),
+        model_meta=mcfg.to_meta(),
+        eval_fn=eval_top1,
+    )
+
+
+def _moe_workload(cfg: WorkerConfig) -> Workload:
+    """Mixture-of-Experts decoder under elastic DPxEP (no reference
+    analog — SURVEY §2.5 "Expert parallelism: NO"; mesh "ep=2,dp"
+    pins the expert axis while dp absorbs membership change)."""
+    import jax
+
+    from edl_tpu.models import moe
+
+    mcfg = moe.MoEConfig.tiny(vocab=cfg.vocab)
+
+    def batch_fn(start: int, end: int) -> Dict[str, np.ndarray]:
+        r = np.random.RandomState(cfg.seed * 1_000_003 + start + 1)
+        return moe.synthetic_tokens(r, end - start, cfg.seq_len, cfg.vocab)
+
+    return Workload(
+        lambda: moe.init_params(jax.random.PRNGKey(cfg.seed), mcfg),
+        moe.make_loss_fn(mcfg),
+        batch_fn,
+        pspecs=lambda plan: moe.param_pspecs(mcfg, plan),
+        model_meta=mcfg.to_meta(),
+        eval_fn=_lm_ppl_eval(lambda p, t: moe.forward(p, t, mcfg)[0]),
+    )
+
+
+WORKLOADS: Dict[str, Callable[[WorkerConfig], Workload]] = {
+    "linreg": _linreg_workload,
+    "ctr": _ctr_workload,
+    "llama": _llama_workload,
+    "bert": _bert_workload,
+    "resnet": _resnet_workload,
+    "moe": _moe_workload,
+}

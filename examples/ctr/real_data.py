@@ -87,6 +87,10 @@ def prepare(data_dir: str, test_fraction: float = 0.2, seed: int = 0) -> dict:
     shards.write_shards(
         os.path.join(data_dir, "eval"), rows(test), shard_size=256
     )
+    # provenance marker: lets train.py --real-data distinguish this dir
+    # from a synthetic one instead of silently training on noise
+    with open(os.path.join(data_dir, "REAL_DATA"), "w") as f:
+        f.write("breast_cancer_wdbc\n")
     return man
 
 

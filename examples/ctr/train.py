@@ -86,12 +86,22 @@ def main() -> int:
 
     # -- dataset: real files, prepared once (image-prebake analog) ---------
     data_dir = args.data_dir or tempfile.mkdtemp(prefix="ctr_shards_")
-    if not os.path.exists(os.path.join(data_dir, "manifest.json")):
+    have_manifest = os.path.exists(os.path.join(data_dir, "manifest.json"))
+    real_marker = os.path.exists(os.path.join(data_dir, "REAL_DATA"))
+    if args.real_data and have_manifest and not real_marker:
+        # NEVER silently train "real" on a dir of synthetic shards — a
+        # reused --data-dir must match the flag
+        print(
+            f"--real-data but {data_dir} holds a non-real dataset "
+            f"(no REAL_DATA marker); point --data-dir elsewhere",
+            file=sys.stderr,
+        )
+        return 1
+    if not have_manifest:
         if args.real_data:
             import real_data
 
             man = real_data.prepare(data_dir)
-            args.vocab = real_data.VOCAB
             print(
                 f"prepared {man['n_samples']} REAL rows of CTR data "
                 f"under {data_dir}"
@@ -100,6 +110,12 @@ def main() -> int:
             rows = ctr.synthetic_batch(rng, args.samples, vocab=args.vocab)
             write_shards(data_dir, rows, shard_size=8192)
             print(f"prepared {args.samples} rows of CTR data under {data_dir}")
+    if args.real_data:
+        import real_data
+
+        # the model's hash space must match the prepared ids whether
+        # the shards were written now or on a previous run
+        args.vocab = real_data.VOCAB
     source = FileShardSource(data_dir)
     queue = ElasticDataQueue(
         source.n_samples, chunk_size=512, passes=10**6

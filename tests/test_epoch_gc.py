@@ -1,0 +1,171 @@
+"""Deterministic tests of the epoch-scoped KV GC ledger (VERDICT r4
+#4): the two-lane deferral protocol of runtime/epoch_gc.py, driven
+through simulated epoch sequences with NO subprocesses and NO timing —
+the invariant ("no key is deleted while a reader can still need it,
+none leaks") previously rode only on multiproc luck."""
+
+import pytest
+
+from edl_tpu.runtime.epoch_gc import EpochKeyGC
+
+
+class FakeKV:
+    """Dict-backed KV recording every delete, so a test can assert
+    exactly WHEN a key died relative to the protocol sequence."""
+
+    def __init__(self):
+        self.data = {}
+        self.deletes = []
+
+    def put(self, k, v="1"):
+        self.data[k] = v
+
+    def delete(self, k):
+        self.deletes.append(k)
+        self.data.pop(k, None)
+
+
+def test_defer_deleted_at_next_drain():
+    gc, kv = EpochKeyGC(), FakeKV()
+    kv.put("go/1")
+    gc.defer("go/1")
+    assert "go/1" in kv.data  # still live until the drain point
+    gc.drain(kv.delete)
+    assert "go/1" not in kv.data
+    assert gc.pending() == 0
+
+
+def test_defer_late_survives_exactly_one_drain():
+    """The round-4 foot-gun, as a law: a key written DURING an epoch
+    that same-epoch peers still poll after this worker's drain point
+    must survive THAT drain and die at the next one."""
+    gc, kv = EpochKeyGC(), FakeKV()
+    kv.put("restore/5")
+    gc.defer_late("restore/5")
+    gc.drain(kv.delete)  # the same epoch's own drain
+    assert "restore/5" in kv.data, "deleted while peers still poll it"
+    gc.drain(kv.delete)  # next epoch's drain: readers are gone
+    assert "restore/5" not in kv.data
+    assert gc.pending() == 0
+
+
+def test_worker_epoch_sequence_no_early_delete_no_leak():
+    """The full protocol shape across three epochs: restore decision +
+    restore marks (late lane, written mid-epoch), teardown's go/dist/
+    disc (normal lane, written at epoch exit), dist_done (late lane).
+    At every drain: nothing a same-epoch reader may still poll has
+    died; after two more epochs every key of a finished epoch is gone."""
+    gc, kv = EpochKeyGC(), FakeKV()
+
+    def run_epoch(e):
+        # -- rendezvous + restore phase (before this epoch's drain)
+        kv.put(f"restore/{e}")
+        gc.defer_late(f"restore/{e}")
+        # -- drain point (just after jax.distributed connect)
+        gc.drain(kv.delete)
+        # INVARIANT: this epoch's restore key must survive its own
+        # epoch's drain — peers are still polling it right now
+        assert f"restore/{e}" in kv.data
+        # -- restore marks written after the drain, same epoch
+        kv.put(f"restored/{e}/w0")
+        gc.defer_late(f"restored/{e}/w0")
+        # -- teardown at epoch exit
+        for k in (f"go/{e}", f"dist/{e}", f"disc/{e}/w0", f"disc/{e}/w1"):
+            kv.put(k)
+            gc.defer(k)
+        kv.put(f"dist_done/{e}")
+        gc.defer_late(f"dist_done/{e}")
+
+    for e in range(3):
+        run_epoch(e)
+        if e >= 1:
+            prev = e - 1
+            # teardown keys of the PREVIOUS epoch died at this epoch's
+            # drain (nobody reads them once everyone connected here)...
+            assert f"go/{prev}" not in kv.data
+            assert f"disc/{prev}/w0" not in kv.data
+        if e >= 2:
+            # ...and the previous-previous epoch's late-lane keys are
+            # gone too: nothing leaks beyond two epochs (epoch is the
+            # second path segment of every key here)
+            pp = e - 2
+            assert not any(
+                k.split("/")[1] == str(pp) for k in kv.data
+            ), kv.data
+    # two final drains flush everything owed
+    gc.drain(kv.delete)
+    gc.drain(kv.delete)
+    assert gc.pending() == 0
+    assert kv.data == {}, f"leaked: {kv.data}"
+
+
+def test_regroup_after_failed_restore_defers_again_without_leak():
+    """A failed restore regroups WITHOUT reaching the drain point
+    (worker_main bumps its incarnation and re-rendezvouses): the failed
+    epoch's decision key stays deferred and dies on the eventual
+    successful epoch's schedule, exactly once."""
+    gc, kv = EpochKeyGC(), FakeKV()
+    # epoch 7: decision published, assembly fails before the drain
+    kv.put("restore/7")
+    gc.defer_late("restore/7")
+    # epoch 8 (regroup): new decision, reaches its drain
+    kv.put("restore/8")
+    gc.defer_late("restore/8")
+    gc.drain(kv.delete)
+    assert "restore/7" in kv.data and "restore/8" in kv.data
+    gc.drain(kv.delete)
+    assert "restore/7" not in kv.data and "restore/8" not in kv.data
+    assert kv.deletes.count("restore/7") == 1
+
+
+def test_dead_service_host_sweep_is_late():
+    """A failed distributed init retracts the endpoint and marks the
+    host dismissed; the mark is swept one epoch LATE so the worker
+    cannot win a race against a live host's own dismissal poll."""
+    gc, kv = EpochKeyGC(), FakeKV()
+    kv.put("dist_done/3/9001")
+    gc.defer_late("dist_done/3/9001")
+    gc.drain(kv.delete)  # the retry epoch's drain
+    assert "dist_done/3/9001" in kv.data  # host may still be polling
+    gc.drain(kv.delete)
+    assert "dist_done/3/9001" not in kv.data
+
+
+def test_drain_failure_keeps_remaining_keys_owed():
+    """A transient coordinator hiccup mid-drain must not leak the rest
+    forever: undeleted keys stay owed and the next drain retries them;
+    late keys keep their extra-epoch guarantee (promotion only happens
+    after the due list fully drains)."""
+    gc, kv = EpochKeyGC(), FakeKV()
+    for k in ("a", "b", "c"):
+        kv.put(k)
+        gc.defer(k)
+    kv.put("late1")
+    gc.defer_late("late1")
+
+    calls = []
+
+    def flaky_delete(k):
+        calls.append(k)
+        if len(calls) == 2:
+            raise ConnectionError("coordinator hiccup")
+        kv.delete(k)
+
+    with pytest.raises(ConnectionError):
+        gc.drain(flaky_delete)
+    assert "a" not in kv.data  # first delete landed
+    assert gc.pending() == 3  # b, c still owed + late1 not promoted
+    gc.drain(kv.delete)  # retry: b, c die, late1 promotes
+    assert "b" not in kv.data and "c" not in kv.data
+    assert "late1" in kv.data
+    gc.drain(kv.delete)
+    assert kv.data == {}
+
+
+def test_extend_bulk_api():
+    gc, kv = EpochKeyGC(), FakeKV()
+    gc.extend(["x", "y"])
+    gc.extend(["z"], late=True)
+    assert gc.due == ("x", "y") and gc.late == ("z",)
+    gc.drain(kv.delete)
+    assert kv.deletes == ["x", "y"]

@@ -88,6 +88,7 @@ def test_ctr_train(monkeypatch, capsys, cpu_devices):
     assert "REAL rows" in out and "trained 6 steps" in out
 
 
+@pytest.mark.multiproc  # launches real worker subprocesses
 def test_ctr_real_data_elastic_auc(monkeypatch, capsys, tmp_path):
     """REAL CTR rows end-to-end (VERDICT r4 missing #2): genuine
     clinical rows in Criteo format through the shard pipeline, an
@@ -136,6 +137,7 @@ def test_recognize_digits_static_shards(monkeypatch, capsys, cpu_devices):
     assert "fixed 4 workers" in out
 
 
+@pytest.mark.multiproc  # launches real worker subprocesses
 def test_bert_elastic_pretrain(monkeypatch, capsys):
     """BASELINE config #4: BERT-class elastic DP with checkpoint
     reshard, through the real multi-process runtime with one scale-up."""
@@ -151,6 +153,7 @@ def test_bert_elastic_pretrain(monkeypatch, capsys):
     assert "phase=succeeded" in out and "reshards=1" in out
 
 
+@pytest.mark.multiproc  # launches real worker subprocesses
 def test_resnet_elastic_train(monkeypatch, capsys):
     """BASELINE config #3: ResNet-class elastic all-reduce DP with a
     graceful mid-run scale-down drain."""
@@ -166,6 +169,7 @@ def test_resnet_elastic_train(monkeypatch, capsys):
     assert "phase=succeeded" in out and "reshards=1" in out
 
 
+@pytest.mark.multiproc  # launches real worker subprocesses
 def test_moe_elastic_pretrain(monkeypatch, capsys):
     """Expert parallelism as a workload (no reference analog): MoE
     decoder on an ep=2,dp mesh through the multi-process runtime; the
@@ -182,6 +186,7 @@ def test_moe_elastic_pretrain(monkeypatch, capsys):
     assert "phase=succeeded" in out and "reshards=1" in out
 
 
+@pytest.mark.multiproc  # launches real worker subprocesses
 def test_fit_a_line_real_data(monkeypatch, capsys, tmp_path):
     """REAL public data through the shard pipeline (VERDICT r3 missing
     #2): the bundled diabetes dataset is prepared into runtime/shards

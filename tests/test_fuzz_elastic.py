@@ -27,6 +27,10 @@ import time
 
 import pytest
 
+# real worker subprocesses + live timing: run serially
+# (scripts/run_tests.sh); CPU contention flakes these in-suite
+pytestmark = pytest.mark.multiproc
+
 from edl_tpu.runtime.launcher import ProcessJobLauncher
 
 N_SAMPLES = 6144

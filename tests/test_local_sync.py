@@ -12,6 +12,7 @@ import numpy as np
 import optax
 import pytest
 
+
 import jax
 import jax.numpy as jnp
 
@@ -167,6 +168,7 @@ def test_stepper_rejects_param_sharded_mesh(cpu_devices):
         LocalSyncStepper(ctr.loss_fn, optax.adam(1e-3), plan, mesh)
 
 
+@pytest.mark.multiproc  # real worker subprocesses, live timing
 def test_multiproc_delayed_sync_scale_up(tmp_path):
     """Delayed-sync DP through the REAL multi-process runtime
     (EDL_SYNC_EVERY): K=2 local steps between averages, scaled up

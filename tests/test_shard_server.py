@@ -229,7 +229,7 @@ def test_p2p_veto_per_step_semantics():
     """Veto bookkeeping is one KV key per step with a TTL — blind
     writes for different steps never race, so no lost-update can
     resurrect a doomed step, and expiry unblocks after the TTL."""
-    from edl_tpu.runtime.worker_main import _VETO_TTL_EPOCHS, _veto_active
+    from edl_tpu.runtime.p2p_restore import _VETO_TTL_EPOCHS, _veto_active
 
     assert _veto_active("3", epoch=3)
     assert _veto_active("3", epoch=3 + _VETO_TTL_EPOCHS)

@@ -9,6 +9,8 @@ rows alone.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
+
 import optax
 
 from edl_tpu.models import linreg, llama
@@ -152,6 +154,7 @@ def test_worker_local_batch_weights(tmp_path):
     assert t4 is None and b4["_w"].sum() == 0
 
 
+@pytest.mark.multiproc  # real worker subprocesses, live timing
 def test_multiproc_ragged_tail_trains(tmp_path):
     """Process-runtime e2e on a dataset whose size does NOT divide the
     chunk grid: completes with exact accounting and a decreasing loss."""
