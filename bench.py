@@ -390,6 +390,51 @@ def _decode_step_bytes(cfg, param_bytes: int, b: int, s_pad: int) -> float:
     return param_bytes + kv_bytes
 
 
+def measure_decode(gen_params, cfg, b, t0, max_new, reps=2):
+    """(prefill_s, per_tok_s or None) for one decode-ladder rung, by
+    DIFFERENCING two generation lengths: both programs share an
+    identical prefill + cache build, so the per-run tunnel jitter on
+    the prefill cancels out of the steady-state decode rate (a
+    prefill-subtraction estimate swung >50% between bench runs);
+    prefill_s is then derived by extrapolating the decode cost back
+    out of the short run.
+
+    Module-level so `scripts/exp_int8_decode.py` runs the SAME harness
+    as the published numbers — a private copy there already diverged
+    once (rep counts) before this was shared.
+
+    Bias note: the two programs pad their KV caches to different
+    max_len (t0+short vs t0+long_), so the long run's decode steps
+    attend over a slightly larger S — per_tok is a small systematic
+    OVERestimate (conservative direction) at these sizes, not a
+    cancellation-breaking error."""
+    from edl_tpu.models import llama
+
+    prompt = jnp.asarray(
+        np.random.RandomState(3).randint(0, cfg.vocab, (b, t0), np.int32)
+    )
+    short, long_ = max_new // 2, max_new + max_new // 2
+
+    def timed_gen(n):
+        toks = llama.generate(gen_params, prompt, cfg, max_new=n)
+        int(np.asarray(toks)[0, -1])  # compile + dependent-fetch fence
+        best = float("inf")
+        for _ in range(reps):
+            t1 = time.perf_counter()
+            toks = llama.generate(gen_params, prompt, cfg, max_new=n)
+            int(np.asarray(toks)[0, -1])
+            best = min(best, time.perf_counter() - t1)
+        return best
+
+    t_short = timed_gen(short)
+    t_long = timed_gen(long_)
+    if t_long <= t_short * 1.02:
+        return -1.0, None  # tunnel jitter swamped the window
+    per_tok = (t_long - t_short) / (long_ - short)
+    prefill_s = t_short - short * per_tok
+    return (prefill_s if prefill_s >= 0 else -1.0), per_tok
+
+
 def _llama_decode_bench() -> dict:
     """Serving-path metrics for the KV-cache decode (runtime/export.py
     consumer; VERDICT r3 #3): prefill latency, steady-state decode
@@ -430,42 +475,13 @@ def _llama_decode_bench() -> dict:
         x.size * x.dtype.itemsize for x in jax.tree_util.tree_leaves(params)
     )
 
-    def measure(b, t0, max_new):
-        """(prefill_s, per_tok_s or None) by DIFFERENCING two
-        generation lengths: both programs share an identical prefill +
-        cache build, so the per-run tunnel jitter on the prefill
-        cancels out of the steady-state decode rate (a
-        prefill-subtraction estimate swung >50% between bench runs);
-        prefill_s is then derived by extrapolating the decode cost
-        back out of the short run."""
-        prompt = jnp.asarray(
-            np.random.RandomState(3).randint(0, cfg.vocab, (b, t0), np.int32)
+    def measure(b, t0, max_new, gen_params=None):
+        # B=1 runs are short enough that tunnel jitter competes with
+        # the signal — buy stability with extra (cheap) reps
+        return measure_decode(
+            params if gen_params is None else gen_params,
+            cfg, b, t0, max_new, reps=5 if b == 1 else 2,
         )
-        short, long_ = max_new // 2, max_new + max_new // 2
-
-        def timed_gen(n):
-            toks = llama.generate(params, prompt, cfg, max_new=n)
-            int(np.asarray(toks)[0, -1])  # compile + dependent-fetch fence
-            best = float("inf")
-            for _ in range(2):
-                t1 = time.perf_counter()
-                toks = llama.generate(params, prompt, cfg, max_new=n)
-                int(np.asarray(toks)[0, -1])
-                best = min(best, time.perf_counter() - t1)
-            return best
-
-        # bias note: the two programs pad their KV caches to different
-        # max_len (t0+short vs t0+long_), so the long run's decode
-        # steps attend over a slightly larger S — per_tok is a small
-        # systematic OVERestimate (conservative direction) at these
-        # sizes, not a cancellation-breaking error.
-        t_short = timed_gen(short)
-        t_long = timed_gen(long_)
-        if t_long <= t_short * 1.02:
-            return -1.0, None  # tunnel jitter swamped the window
-        per_tok = (t_long - t_short) / (long_ - short)
-        prefill_s = t_short - short * per_tok
-        return (prefill_s if prefill_s >= 0 else -1.0), per_tok
 
     out: dict = {}
     rungs = []
@@ -510,7 +526,55 @@ def _llama_decode_bench() -> dict:
                 "decode_config": f"B{b}/T0{t0}/new{max_new//2}-{max_new+max_new//2}",
             })
     out["decode_ladder"] = rungs
-    del params
+
+    # -- the quantization lever (VERDICT r4 #3): weight-only int8 ------
+    # Decode streams every matmul-weight byte per token; int8 halves
+    # exactly that term and nothing else, so the lever pays where the
+    # weight stream dominates the step — B=1 latency serving (measured
+    # 2.7x on this chip) — and fades once the KV cache and attention
+    # math amortize it away (1.08x at B=8, 1.05x at B=32; decomposition
+    # in scripts/exp_int8_decode.py). Both the latency rung and the
+    # headline rung are published so the fade is visible, with the
+    # roofline denominator re-counting the quantized tree's actual
+    # bytes.
+    qparams = jax.jit(llama.quantize_params_int8)(params)
+    q_bytes = sum(
+        x.size * x.dtype.itemsize
+        for x in jax.tree_util.tree_leaves(qparams)
+    )
+    base_rate = {r["b"]: r["decode_tokens_per_sec"] for r in rungs}
+    for b, t0, max_new in ladder:
+        if b not in (1, headline):
+            continue
+        prefill_q, per_tok_q = measure(b, t0, max_new, gen_params=qparams)
+        suffix = "" if b == headline else "_b1"
+        # failed-measurement sentinel policy: every key the success
+        # path writes exists with an explicit -1.0, never absent
+        if per_tok_q is None:
+            out.update({
+                f"decode_int8{suffix}_tokens_per_sec": -1.0,
+                f"decode_int8{suffix}_pct_peak_bw": -1.0,
+                f"decode_int8{suffix}_speedup": -1.0,
+            })
+            continue
+        s_pad = t0 + max_new + max_new // 2
+        pct_q = (
+            _decode_step_bytes(cfg, q_bytes, b, s_pad) / per_tok_q / peak_bw
+            if on_tpu
+            else -1.0
+        )
+        rate = round(b / per_tok_q, 1)
+        out.update({
+            f"decode_int8{suffix}_tokens_per_sec": rate,
+            f"decode_int8{suffix}_pct_peak_bw": (
+                round(pct_q, 4) if on_tpu else -1.0
+            ),
+        })
+        base = base_rate.get(b, -1.0)
+        out[f"decode_int8{suffix}_speedup"] = (
+            round(rate / base, 3) if base and base > 0 else -1.0
+        )
+    del params, qparams
     jax.clear_caches()
     return out
 

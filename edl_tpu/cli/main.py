@@ -464,6 +464,12 @@ def run_generate(args) -> int:
             file=sys.stderr,
         )
         return 1
+    if args.int8 and args.mesh:
+        # the int8 records carry no pspecs; sharded serving keeps the
+        # training layout instead of re-deriving one for q8/s8 — and
+        # the check must precede the (multi-GB) load it would waste
+        print("--int8 and --mesh are mutually exclusive", file=sys.stderr)
+        return 1
     import jax
 
     from edl_tpu.models import llama
@@ -514,6 +520,10 @@ def run_generate(args) -> int:
     if (prompt < 0).any() or (prompt >= cfg.vocab).any():
         print(f"prompt tokens outside [0, {cfg.vocab})", file=sys.stderr)
         return 1
+    if args.int8:
+        # weight-only int8: halves decode's weight-bandwidth bill
+        # (models/llama.py quantize_params_int8; bench decode_int8_*)
+        params = jax.jit(llama.quantize_params_int8)(params)
     toks = llama.generate(
         params,
         prompt,
@@ -752,6 +762,13 @@ def build_parser() -> argparse.ArgumentParser:
         help='serve sharded: MeshPlan grammar (e.g. "tp=2", "fsdp") — '
         "params load onto the mesh with the training layout, so exports "
         "bigger than one chip's HBM serve at all",
+    )
+    g.add_argument(
+        "--int8",
+        action="store_true",
+        help="weight-only int8 decode: quantize the export's matmul "
+        "weights (per-output-column absmax) before serving — halves "
+        "the weight-bandwidth bill of small-batch decode",
     )
     g.set_defaults(fn=run_generate)
 

@@ -271,15 +271,64 @@ def attention(
     return jnp.einsum("bhts,bshd->bthd", probs, v)
 
 
+_INT8_WEIGHTS = ("wq", "wk", "wv", "wo", "w1", "w2", "w3")
+
+
+def _matw(a: jnp.ndarray, p) -> jnp.ndarray:
+    """``a @ W`` where ``W`` is a plain weight array or a weight-only
+    int8 record ``{"q8", "s8"}`` from :func:`quantize_params_int8`.
+
+    The int8 form computes ``(a @ q8) * s8`` — mathematically equal to
+    ``a @ (q8 * s8)`` because ``s8`` is constant along the contraction
+    axis — so the dot's rhs is a bare ``convert(int8→dt)`` that XLA
+    fuses into the operand read: HBM streams the int8 bytes and no
+    dequantized weight temp is ever materialized. That halved traffic
+    is the whole point — small-batch decode is weight-bandwidth-bound
+    (see bench.py ``_decode_step_bytes``)."""
+    dt = a.dtype
+    if isinstance(p, dict):
+        return (a @ p["q8"].astype(dt)) * p["s8"].astype(dt)
+    return a @ p.astype(dt)
+
+
+def quantize_params_int8(params: Dict) -> Dict:
+    """Weight-only int8 for the serving/decode path (the quantization
+    lever of VERDICT r4 #3): every matmul weight the decode step
+    streams — the seven per-layer projection matrices and ``lm_head``
+    — becomes ``{"q8": int8 [..., din, dout], "s8": f32 [..., dout]}``
+    with symmetric per-output-column absmax scales, so the max error
+    per element is ``colmax/254``. Master weights are untouched; the
+    embedding stays dense (decode gathers B rows of it per step, not
+    the whole table, so quantizing it buys no bandwidth) and norm
+    scales are vectors. The returned tree feeds ``generate``/
+    ``forward`` unchanged — ``_matw`` dispatches on the record."""
+
+    def q(w):
+        m = jnp.max(jnp.abs(w), axis=-2, keepdims=True)  # over din
+        s = jnp.where(m > 0, m / 127.0, jnp.ones_like(m))
+        q8 = (
+            jnp.clip(jnp.round(w.astype(jnp.float32) / s), -127, 127)
+            .astype(jnp.int8)
+        )
+        return {"q8": q8, "s8": s[..., 0, :].astype(jnp.float32)}
+
+    out = dict(params)
+    out["layers"] = {
+        k: (q(v) if k in _INT8_WEIGHTS else v)
+        for k, v in params["layers"].items()
+    }
+    out["lm_head"] = q(params["lm_head"])
+    return out
+
+
 def _qkv(cfg: LlamaConfig, a: jnp.ndarray, lp: Dict, positions=None):
     """Projections + RoPE — shared by the training layer and the
     KV-cache decode so the model math cannot diverge between them."""
     b, t, _ = a.shape
     h, kv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    dt = a.dtype
-    q = (a @ lp["wq"].astype(dt)).reshape(b, t, h, hd)
-    k = (a @ lp["wk"].astype(dt)).reshape(b, t, kv, hd)
-    v = (a @ lp["wv"].astype(dt)).reshape(b, t, kv, hd)
+    q = _matw(a, lp["wq"]).reshape(b, t, h, hd)
+    k = _matw(a, lp["wk"]).reshape(b, t, kv, hd)
+    v = _matw(a, lp["wv"]).reshape(b, t, kv, hd)
     q = _rope(q, cfg.rope_theta, positions)
     k = _rope(k, cfg.rope_theta, positions)
     return q, k, v
@@ -288,11 +337,10 @@ def _qkv(cfg: LlamaConfig, a: jnp.ndarray, lp: Dict, positions=None):
 def _mlp(cfg: LlamaConfig, x: jnp.ndarray, lp: Dict) -> jnp.ndarray:
     """Post-attention SwiGLU block (residual included) — shared by the
     training layer and the decode step."""
-    dt = x.dtype
     m = _rmsnorm(x, lp["ln2"], cfg.norm_eps)
-    gate = checkpoint_name(jax.nn.silu(m @ lp["w1"].astype(dt)), "mlp_gate")
-    up = checkpoint_name(m @ lp["w3"].astype(dt), "mlp_up")
-    return x + (gate * up) @ lp["w2"].astype(dt)
+    gate = checkpoint_name(jax.nn.silu(_matw(m, lp["w1"])), "mlp_gate")
+    up = checkpoint_name(_matw(m, lp["w3"]), "mlp_up")
+    return x + _matw(gate * up, lp["w2"])
 
 
 def _layer(
@@ -308,11 +356,10 @@ def _layer(
     training path must NOT set it (materializing every layer's K/V
     across the scan costs O(L·B·T) HBM)."""
     b, t, d = x.shape
-    dt = x.dtype
     a = _rmsnorm(x, lp["ln1"], cfg.norm_eps)
     q, k, v = _qkv(cfg, a, lp)
     o = attention(q, k, v, cfg, mesh=mesh, sp=sp).reshape(b, t, -1)
-    x = x + o @ lp["wo"].astype(dt)
+    x = x + _matw(o, lp["wo"])
     out = _mlp(cfg, x, lp)
     return (out, k, v) if with_kv else out
 
@@ -445,7 +492,7 @@ def forward(
     else:
         x, _ = jax.lax.scan(body, x, params["layers"])
     x = _rmsnorm(x, params["ln_f"], cfg.norm_eps)
-    return (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+    return _matw(x, params["lm_head"]).astype(jnp.float32)
 
 
 # -- inference: KV-cache decode ---------------------------------------------
@@ -470,9 +517,7 @@ def _prefill(params: Dict, tokens: jnp.ndarray, cfg: LlamaConfig):
 
     x, (ks, vs) = jax.lax.scan(body, x, params["layers"])
     x = _rmsnorm(x, params["ln_f"], cfg.norm_eps)
-    logits = (x[:, -1] @ params["lm_head"].astype(cfg.dtype)).astype(
-        jnp.float32
-    )
+    logits = _matw(x[:, -1], params["lm_head"]).astype(jnp.float32)
     return logits, ks, vs
 
 
@@ -506,14 +551,12 @@ def _decode_step(params: Dict, tok: jnp.ndarray, pos, kc, vc, cfg: LlamaConfig):
         scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
         probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dt)
         o = jnp.einsum("bkgts,bskd->btkgd", probs, vci).reshape(b, 1, h * hd)
-        xx = xx + o @ lp["wo"].astype(dt)
+        xx = xx + _matw(o, lp["wo"])
         return _mlp(cfg, xx, lp), (kci, vci)
 
     x, (kc, vc) = jax.lax.scan(body, x, (params["layers"], kc, vc))
     x = _rmsnorm(x, params["ln_f"], cfg.norm_eps)
-    logits = (x[:, 0] @ params["lm_head"].astype(cfg.dtype)).astype(
-        jnp.float32
-    )
+    logits = _matw(x[:, 0], params["lm_head"]).astype(jnp.float32)
     return logits, kc, vc
 
 

@@ -1,0 +1,85 @@
+"""Where does weight-only int8 pay on the decode ladder? (VERDICT r4 #3)
+
+One-off decomposition behind the `decode_int8_*` bench keys: runs the
+SAME differencing harness as bench.py's decode ladder at every batch
+rung, bf16 vs int8, and prints a per-rung table plus the implied
+non-weight time per step.
+
+Model: a decode step's time = weight-stream time + everything else
+(KV-cache read, f32 softmax, cache update, scan/dispatch overhead).
+Weight-only int8 halves ONLY the first term, so
+
+    speedup(B) = t_bf16 / (t_bf16 - saved),  saved <= weight_bytes/2 / BW
+
+The rung where the speedup is largest is the rung where weights
+dominate — B=1 by construction; by B=32 the same weight bytes amortize
+over 4x the tokens and the lever fades. Run on the real chip:
+
+    python scripts/exp_int8_decode.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from bench import measure_decode
+
+
+def main() -> None:
+    from edl_tpu.models import llama
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        cfg = llama.LlamaConfig(
+            vocab=32768, d_model=2048, n_layers=16, n_heads=16,
+            n_kv_heads=8, d_ff=6144, dtype=jnp.bfloat16, use_flash=True,
+        )
+        ladder = [(1, 512, 64), (8, 512, 64), (32, 512, 64)]
+    else:  # smoke
+        cfg = llama.LlamaConfig.tiny(vocab=512)
+        ladder = [(2, 32, 8)]
+
+    params = jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.bfloat16) if on_tpu else x,
+        jax.jit(lambda: llama.init_params(jax.random.PRNGKey(2), cfg))(),
+    )
+    qparams = jax.jit(llama.quantize_params_int8)(params)
+
+    def tree_bytes(t):
+        return sum(
+            x.size * x.dtype.itemsize for x in jax.tree_util.tree_leaves(t)
+        )
+
+    wb_bf16 = tree_bytes(params) - params["embed"].size * params["embed"].dtype.itemsize
+    wb_int8 = tree_bytes(qparams) - qparams["embed"].size * qparams["embed"].dtype.itemsize
+
+    def per_tok(gp, b, t0, max_new):
+        # bench.py's harness verbatim, same rep policy as the
+        # published decode_* keys
+        _, pt = measure_decode(
+            gp, cfg, b, t0, max_new, reps=5 if b == 1 else 2
+        )
+        return pt
+
+    print(f"weight bytes: bf16 {wb_bf16/1e9:.2f} GB, int8 {wb_int8/1e9:.2f} GB")
+    print(f"{'B':>4} {'bf16 ms/step':>13} {'int8 ms/step':>13} {'speedup':>8} "
+          f"{'saved ms':>9} {'max-savable ms @819GB/s':>24}")
+    for b, t0, max_new in ladder:
+        tb = per_tok(params, b, t0, max_new)
+        tq = per_tok(qparams, b, t0, max_new)
+        if tb is None or tq is None:
+            print(f"{b:>4}  jitter-swamped")
+            continue
+        savable = (wb_bf16 - wb_int8) / 819e9 * 1e3 if on_tpu else float("nan")
+        print(
+            f"{b:>4} {tb*1e3:>13.2f} {tq*1e3:>13.2f} {tb/tq:>8.3f} "
+            f"{(tb-tq)*1e3:>9.2f} {savable:>24.2f}"
+        )
+
+
+if __name__ == "__main__":
+    main()

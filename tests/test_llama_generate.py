@@ -187,3 +187,128 @@ def test_cli_generate_sharded_mesh(tmp_path):
         params, jnp.asarray([[1, 2, 3, 4]], jnp.int32), cfg, max_new=5
     )
     assert toks == [int(t) for t in np.asarray(want)[0]]
+
+
+# -- weight-only int8 decode (the serving quantization lever) ---------------
+
+
+def _dequant_dense(qp):
+    """Fold every {"q8","s8"} record back to a dense f32 matrix — the
+    math `_matw` must be exactly equivalent to (modulo one float
+    reassociation)."""
+
+    def fold(node):
+        if isinstance(node, dict):
+            if set(node) == {"q8", "s8"}:
+                return node["q8"].astype(jnp.float32) * node["s8"][
+                    ..., None, :
+                ].astype(jnp.float32)
+            return {k: fold(v) for k, v in node.items()}
+        return node
+
+    return fold(qp)
+
+
+def test_int8_quantize_structure_and_error_bound():
+    """Symmetric per-output-column absmax: every matmul weight becomes
+    an int8 record whose reconstruction error is <= colmax/254 per
+    element; embedding and norm scales stay dense."""
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(jax.random.PRNGKey(3), cfg)
+    qp = llama.quantize_params_int8(params)
+
+    assert not isinstance(qp["embed"], dict)
+    assert not isinstance(qp["layers"]["ln1"], dict)
+    assert not isinstance(qp["ln_f"], dict)
+    for name in ("wq", "wk", "wv", "wo", "w1", "w2", "w3"):
+        rec = qp["layers"][name]
+        assert set(rec) == {"q8", "s8"}, name
+        assert rec["q8"].dtype == jnp.int8
+        w = np.asarray(params["layers"][name])
+        r = np.asarray(rec["q8"], np.float32) * np.asarray(rec["s8"])[
+            ..., None, :
+        ]
+        colmax = np.abs(w).max(axis=-2)
+        assert (np.abs(w - r).max(axis=-2) <= colmax / 254 + 1e-7).all(), name
+    assert set(qp["lm_head"]) == {"q8", "s8"}
+
+
+def test_int8_forward_matches_dequantized_oracle():
+    """`_matw`'s (a @ q8) * s8 must equal a @ (q8 * s8) — the int8
+    record is a lossless re-association of the dequantized matmul, so
+    forward logits through the record match a dense fold of it."""
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(jax.random.PRNGKey(4), cfg)
+    qp = llama.quantize_params_int8(params)
+    dense = _dequant_dense(qp)
+    toks = jnp.asarray(
+        np.random.RandomState(0).randint(0, cfg.vocab, (2, 12), np.int32)
+    )
+    got = np.asarray(llama.forward(qp, toks, cfg))
+    want = np.asarray(llama.forward(dense, toks, cfg))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_int8_generate_fidelity():
+    """Greedy decode through the int8 records: identical tokens to the
+    dequantized-dense oracle, and logits within quantization noise of
+    the full-precision model."""
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(jax.random.PRNGKey(5), cfg)
+    qp = llama.quantize_params_int8(params)
+    prompt = jnp.asarray(
+        np.random.RandomState(1).randint(0, cfg.vocab, (2, 8), np.int32)
+    )
+    got = np.asarray(llama.generate(qp, prompt, cfg, max_new=6))
+    want = np.asarray(
+        llama.generate(_dequant_dense(qp), prompt, cfg, max_new=6)
+    )
+    np.testing.assert_array_equal(got, want)
+
+    l_full = np.asarray(llama.forward(params, prompt, cfg))
+    l_q = np.asarray(llama.forward(qp, prompt, cfg))
+    assert np.abs(l_full - l_q).max() < 0.3 * l_full.std()
+
+
+def test_cli_generate_int8(tmp_path):
+    """`edl generate --int8` serves the export through the weight-only
+    int8 records; on the tiny model greedy tokens match full precision."""
+    import os
+    import subprocess
+    import sys
+
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    export_params(
+        str(tmp_path), params, step=1, dtype="float32",
+        model_meta=cfg.to_meta(),
+    )
+    env = {
+        **os.environ,
+        "PYTHONPATH": os.path.dirname(os.path.dirname(__file__)),
+        "JAX_PLATFORMS": "cpu",
+    }
+    out = subprocess.run(
+        [
+            sys.executable, "-m", "edl_tpu.cli", "generate", str(tmp_path),
+            "--prompt", "1,2,3,4", "--max-new", "5", "--int8",
+        ],
+        capture_output=True, text=True, env=env,
+    )
+    assert out.returncode == 0, out.stderr
+    toks = [int(t) for t in out.stdout.strip().split(",")]
+    qp = llama.quantize_params_int8(params)
+    want = llama.generate(
+        qp, jnp.asarray([[1, 2, 3, 4]], jnp.int32), cfg, max_new=5
+    )
+    assert toks == [int(t) for t in np.asarray(want)[0]]
+
+    both = subprocess.run(
+        [
+            sys.executable, "-m", "edl_tpu.cli", "generate", str(tmp_path),
+            "--prompt", "1,2", "--max-new", "2", "--int8", "--mesh", "tp=2",
+        ],
+        capture_output=True, text=True, env=env,
+    )
+    assert both.returncode == 1
+    assert "mutually exclusive" in both.stderr
