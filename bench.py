@@ -185,6 +185,18 @@ def _llama_flagship_bench(n_dev, plan, mesh, rng) -> dict:
         lcfg, long_t, long_ladder, lsteps, max(lreps // 2, 1),
         n_dev, plan, mesh, rng,
     )
+    # int8 MXU training (VERDICT r4 #8): same config, the seven
+    # projection matmuls on the double-rate int8 path
+    # (ops/int8_matmul.py). Published beside the bf16 headline — `mfu`
+    # stays bf16 for cross-round comparability; `int8_mfu` is
+    # model-FLOPs over the *bf16* peak (an effective-MFU: >bf16-mfu
+    # means the int8 path beat what bf16 could ever reach).
+    import dataclasses as _dc
+
+    int8_rate, int8_batch, _ = _llama_measure(
+        _dc.replace(lcfg, int8_mxu=True), lt, ladder, lsteps, lreps,
+        n_dev, plan, mesh, rng,
+    )
 
     peak = _peak_flops(jax.devices()[0])
     fpt = llama.train_flops_per_token(lcfg, lt)
@@ -192,6 +204,18 @@ def _llama_flagship_bench(n_dev, plan, mesh, rng) -> dict:
     return {
         "llama_tokens_per_sec_per_chip": round(ltok_rate, 1),
         "mfu": round(ltok_rate * fpt / peak, 4) if on_tpu else 0.0,
+        "llama_int8_tokens_per_sec_per_chip": round(int8_rate, 1),
+        "int8_mfu": round(int8_rate * fpt / peak, 4) if on_tpu else 0.0,
+        "llama_int8_batch": int8_batch,
+        # a speedup is only a quantization effect if both runs settled
+        # on the SAME ladder rung (the int8 run holds extra in-flight
+        # quantized operands and could step down where bf16 didn't) —
+        # a rung mismatch publishes the explicit sentinel instead
+        "int8_train_speedup": (
+            round(int8_rate / ltok_rate, 3)
+            if ltok_rate > 0 and int8_batch == used_batch
+            else -1.0
+        ),
         "llama_config": (
             f"d{lcfg.d_model}/L{lcfg.n_layers}/ff{lcfg.d_ff}/"
             f"v{lcfg.vocab}/T{lt}/b{used_batch}"
@@ -390,7 +414,7 @@ def _decode_step_bytes(cfg, param_bytes: int, b: int, s_pad: int) -> float:
     return param_bytes + kv_bytes
 
 
-def measure_decode(gen_params, cfg, b, t0, max_new, reps=2):
+def measure_decode(gen_params, cfg, b, t0, max_new, reps=None):
     """(prefill_s, per_tok_s or None) for one decode-ladder rung, by
     DIFFERENCING two generation lengths: both programs share an
     identical prefill + cache build, so the per-run tunnel jitter on
@@ -410,6 +434,11 @@ def measure_decode(gen_params, cfg, b, t0, max_new, reps=2):
     cancellation-breaking error."""
     from edl_tpu.models import llama
 
+    if reps is None:
+        # B=1 runs are short enough that tunnel jitter competes with
+        # the signal — buy stability with extra (cheap) reps. Lives
+        # HERE so every caller shares one rep policy.
+        reps = 5 if b == 1 else 2
     prompt = jnp.asarray(
         np.random.RandomState(3).randint(0, cfg.vocab, (b, t0), np.int32)
     )
@@ -476,11 +505,9 @@ def _llama_decode_bench() -> dict:
     )
 
     def measure(b, t0, max_new, gen_params=None):
-        # B=1 runs are short enough that tunnel jitter competes with
-        # the signal — buy stability with extra (cheap) reps
         return measure_decode(
             params if gen_params is None else gen_params,
-            cfg, b, t0, max_new, reps=5 if b == 1 else 2,
+            cfg, b, t0, max_new,
         )
 
     out: dict = {}

@@ -65,6 +65,13 @@ class LlamaConfig:
     # GPipe microbatch count when the plan has a pp axis (0 = one
     # microbatch per stage). Bubble fraction (pp-1)/(n_micro+pp-1).
     pp_microbatches: int = 0
+    # run the seven per-layer projection matmuls on the MXU's
+    # double-rate int8 path (ops/int8_matmul.py: dynamic absmax
+    # quantization of both operands in flight, STE gradients, fwd +
+    # dgrad + wgrad all int8). Master weights/optimizer/attention/
+    # lm_head stay full precision; training-only (never rides
+    # to_meta — exports are dense, serving unaffected).
+    int8_mxu: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -274,20 +281,29 @@ def attention(
 _INT8_WEIGHTS = ("wq", "wk", "wv", "wo", "w1", "w2", "w3")
 
 
-def _matw(a: jnp.ndarray, p) -> jnp.ndarray:
+def _matw(a: jnp.ndarray, p, int8_mxu: bool = False) -> jnp.ndarray:
     """``a @ W`` where ``W`` is a plain weight array or a weight-only
     int8 record ``{"q8", "s8"}`` from :func:`quantize_params_int8`.
 
-    The int8 form computes ``(a @ q8) * s8`` — mathematically equal to
-    ``a @ (q8 * s8)`` because ``s8`` is constant along the contraction
-    axis — so the dot's rhs is a bare ``convert(int8→dt)`` that XLA
-    fuses into the operand read: HBM streams the int8 bytes and no
-    dequantized weight temp is ever materialized. That halved traffic
-    is the whole point — small-batch decode is weight-bandwidth-bound
-    (see bench.py ``_decode_step_bytes``)."""
+    The int8 record form computes ``(a @ q8) * s8`` — mathematically
+    equal to ``a @ (q8 * s8)`` because ``s8`` is constant along the
+    contraction axis — so the dot's rhs is a bare ``convert(int8→dt)``
+    that XLA fuses into the operand read: HBM streams the int8 bytes
+    and no dequantized weight temp is ever materialized. That halved
+    traffic is the whole point — small-batch decode is
+    weight-bandwidth-bound (see bench.py ``_decode_step_bytes``).
+
+    ``int8_mxu`` (training, ``LlamaConfig.int8_mxu``) instead runs the
+    dense matmul on the MXU's double-rate int8 path with dynamic
+    quantization of BOTH operands and STE gradients
+    (``ops/int8_matmul.py``) — a throughput lever, not a memory one."""
     dt = a.dtype
     if isinstance(p, dict):
         return (a @ p["q8"].astype(dt)) * p["s8"].astype(dt)
+    if int8_mxu:
+        from edl_tpu.ops.int8_matmul import int8_matmul
+
+        return int8_matmul(a, p.astype(dt))
     return a @ p.astype(dt)
 
 
@@ -303,14 +319,11 @@ def quantize_params_int8(params: Dict) -> Dict:
     scales are vectors. The returned tree feeds ``generate``/
     ``forward`` unchanged — ``_matw`` dispatches on the record."""
 
+    from edl_tpu.ops.int8_matmul import absmax_quant
+
     def q(w):
-        m = jnp.max(jnp.abs(w), axis=-2, keepdims=True)  # over din
-        s = jnp.where(m > 0, m / 127.0, jnp.ones_like(m))
-        q8 = (
-            jnp.clip(jnp.round(w.astype(jnp.float32) / s), -127, 127)
-            .astype(jnp.int8)
-        )
-        return {"q8": q8, "s8": s[..., 0, :].astype(jnp.float32)}
+        q8, s = absmax_quant(w, -2)  # absmax over din: per-out-column
+        return {"q8": q8, "s8": s[..., 0, :]}
 
     out = dict(params)
     out["layers"] = {
@@ -326,9 +339,10 @@ def _qkv(cfg: LlamaConfig, a: jnp.ndarray, lp: Dict, positions=None):
     KV-cache decode so the model math cannot diverge between them."""
     b, t, _ = a.shape
     h, kv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    q = _matw(a, lp["wq"]).reshape(b, t, h, hd)
-    k = _matw(a, lp["wk"]).reshape(b, t, kv, hd)
-    v = _matw(a, lp["wv"]).reshape(b, t, kv, hd)
+    i8 = cfg.int8_mxu
+    q = _matw(a, lp["wq"], i8).reshape(b, t, h, hd)
+    k = _matw(a, lp["wk"], i8).reshape(b, t, kv, hd)
+    v = _matw(a, lp["wv"], i8).reshape(b, t, kv, hd)
     q = _rope(q, cfg.rope_theta, positions)
     k = _rope(k, cfg.rope_theta, positions)
     return q, k, v
@@ -337,10 +351,11 @@ def _qkv(cfg: LlamaConfig, a: jnp.ndarray, lp: Dict, positions=None):
 def _mlp(cfg: LlamaConfig, x: jnp.ndarray, lp: Dict) -> jnp.ndarray:
     """Post-attention SwiGLU block (residual included) — shared by the
     training layer and the decode step."""
+    i8 = cfg.int8_mxu
     m = _rmsnorm(x, lp["ln2"], cfg.norm_eps)
-    gate = checkpoint_name(jax.nn.silu(_matw(m, lp["w1"])), "mlp_gate")
-    up = checkpoint_name(_matw(m, lp["w3"]), "mlp_up")
-    return x + _matw(gate * up, lp["w2"])
+    gate = checkpoint_name(jax.nn.silu(_matw(m, lp["w1"], i8)), "mlp_gate")
+    up = checkpoint_name(_matw(m, lp["w3"], i8), "mlp_up")
+    return x + _matw(gate * up, lp["w2"], i8)
 
 
 def _layer(
@@ -359,7 +374,7 @@ def _layer(
     a = _rmsnorm(x, lp["ln1"], cfg.norm_eps)
     q, k, v = _qkv(cfg, a, lp)
     o = attention(q, k, v, cfg, mesh=mesh, sp=sp).reshape(b, t, -1)
-    x = x + _matw(o, lp["wo"])
+    x = x + _matw(o, lp["wo"], cfg.int8_mxu)
     out = _mlp(cfg, x, lp)
     return (out, k, v) if with_kv else out
 
@@ -580,6 +595,14 @@ def generate(
         raise ValueError("sampling (temperature > 0) needs a PRNG key")
     if max_new < 1:
         raise ValueError(f"max_new must be >= 1, got {max_new}")
+    if cfg.int8_mxu:
+        # training-only throughput flag: left on it would dynamically
+        # quantize SOME decode matmuls (the _qkv/_mlp shared ones) but
+        # not others — silently inconsistent numerics on the serving
+        # path. Serving quantization is quantize_params_int8 instead.
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, int8_mxu=False)
     b, t0 = tokens.shape
     run = _generate_program(cfg, b, t0, int(max_new), temperature > 0)
     return run(

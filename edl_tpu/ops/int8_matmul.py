@@ -1,0 +1,98 @@
+"""Dynamic int8 quantized matmul — the 2x MXU training lever.
+
+The v5e MXU runs int8 x int8 -> int32 at twice its bf16 rate (394 vs
+197 peak TOPS; measured on this chip: 346 vs 197.7 at M8192/K2048/
+N6144 — ``scripts/exp_int8_train.py``). This module makes that rate
+available to training matmuls the AQT way (no reference analog — the
+reference trains f32 on 2018 CPUs/GPUs):
+
+- **symmetric dynamic absmax scales per contraction-slice**: each
+  operand is quantized along its contraction axis (row-wise for the
+  activations, column-wise for the weights), so the scales factor OUT
+  of the dot and the int32 accumulator is exact for the quantized
+  values. Max quantization error per element is slicemax/254.
+- **all three training matmuls** run int8: the forward product, and in
+  the backward both dgrad (g @ W^T) and wgrad (a^T @ g), each with
+  fresh scales along ITS contraction axis (a tensor quantized for one
+  contraction is useless for the transposed one).
+- **straight-through estimator**: gradients are computed as if the
+  forward were the exact matmul — the quantizer's zero-derivative
+  staircase is ignored. Standard practice; the loss-curve cost is
+  measured, not assumed (tests/test_int8_matmul.py, exp script).
+
+Master weights, optimizer state, and every non-matmul op stay in their
+usual dtypes — this quantizes the MXU's operands in flight, nothing
+at rest. Wired into the flagship via ``LlamaConfig.int8_mxu``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def absmax_quant(x: jnp.ndarray, axis: int):
+    """Symmetric int8 quantization of ``x`` along ``axis`` (the
+    contraction axis of the dot it feeds): q int8, s f32 broadcastable
+    against x, with x ~= q * s and |error| <= absmax/254 per element.
+    All-zero slices take scale 1 (q = 0) — no 0/0."""
+    xf = x.astype(jnp.float32)
+    m = jnp.max(jnp.abs(xf), axis=axis, keepdims=True)
+    s = jnp.where(m > 0, m / 127.0, jnp.ones_like(m))
+    q = jnp.clip(jnp.round(xf / s), -127, 127).astype(jnp.int8)
+    return q, s
+
+
+def _dot8(qa, qb, dims):
+    """int8 x int8 -> int32 dot_general — the MXU's double-rate path.
+    ``preferred_element_type=int32`` is what keeps XLA from widening
+    the operands to bf16 first (which would forfeit the 2x)."""
+    return lax.dot_general(
+        qa, qb, (dims, ((), ())), preferred_element_type=jnp.int32
+    )
+
+
+def _mm(a: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    """Quantized ``a @ w`` for a [..., K] activation and [K, N] weight."""
+    shape = a.shape
+    a2 = a.reshape(-1, shape[-1])
+    qa, sa = absmax_quant(a2, 1)  # per activation row
+    qw, sw = absmax_quant(w, 0)  # per weight column
+    y = _dot8(qa, qw, ((1,), (0,))).astype(jnp.float32) * (sa * sw)
+    return y.astype(a.dtype).reshape(shape[:-1] + (w.shape[-1],))
+
+
+@jax.custom_vjp
+def int8_matmul(a: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    """``a @ w`` on the int8 MXU path with STE gradients.
+
+    a: [..., K] activations (any leading dims), w: [K, N] weights.
+    Returns [..., N] in ``a.dtype``.
+    """
+    return _mm(a, w)
+
+
+def _fwd(a, w):
+    # residuals are the raw operands — exactly what plain autodiff of
+    # a dense matmul would save, so remat policies see nothing new
+    return _mm(a, w), (a, w)
+
+
+def _bwd(res, g):
+    a, w = res
+    k = a.shape[-1]
+    a2 = a.reshape(-1, k)
+    g2 = g.reshape(-1, g.shape[-1])
+    # dgrad da = g @ w^T contracts N: fresh scales along N for both
+    qg, sg = absmax_quant(g2, 1)  # [M, 1]
+    qwn, swn = absmax_quant(w, 1)  # [K, 1] per weight ROW this time
+    da = _dot8(qg, qwn, ((1,), (1,))).astype(jnp.float32) * (sg * swn.T)
+    # wgrad dw = a^T @ g contracts M: fresh scales along M for both
+    qam, sam = absmax_quant(a2, 0)  # [1, K]
+    qgm, sgm = absmax_quant(g2, 0)  # [1, N]
+    dw = _dot8(qam, qgm, ((0,), (0,))).astype(jnp.float32) * (sam.T * sgm)
+    return da.astype(a.dtype).reshape(a.shape), dw.astype(w.dtype)
+
+
+int8_matmul.defvjp(_fwd, _bwd)

@@ -145,11 +145,15 @@ def _llama_workload(cfg: WorkerConfig) -> Workload:
     """The flagship: Llama decoder under elastic FSDP(×TP) — BASELINE
     config #5 ("Llama-3-8B elastic FSDP across growing TPU slice") at
     the configured scale (tests: LlamaConfig.tiny)."""
+    import dataclasses
+
     import jax
 
     from edl_tpu.models import llama
 
-    mcfg = llama.LlamaConfig.tiny(vocab=cfg.vocab)
+    mcfg = dataclasses.replace(
+        llama.LlamaConfig.tiny(vocab=cfg.vocab), int8_mxu=cfg.int8_mxu
+    )
 
     def batch_fn(start: int, end: int) -> Dict[str, np.ndarray]:
         r = np.random.RandomState(cfg.seed * 1_000_003 + start + 1)

@@ -58,11 +58,9 @@ def main() -> None:
     wb_int8 = tree_bytes(qparams) - qparams["embed"].size * qparams["embed"].dtype.itemsize
 
     def per_tok(gp, b, t0, max_new):
-        # bench.py's harness verbatim, same rep policy as the
-        # published decode_* keys
-        _, pt = measure_decode(
-            gp, cfg, b, t0, max_new, reps=5 if b == 1 else 2
-        )
+        # bench.py's harness verbatim, including its rep policy —
+        # the published decode_* keys and this table stay comparable
+        _, pt = measure_decode(gp, cfg, b, t0, max_new)
         return pt
 
     print(f"weight bytes: bf16 {wb_bf16/1e9:.2f} GB, int8 {wb_int8/1e9:.2f} GB")
