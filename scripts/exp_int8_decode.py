@@ -32,13 +32,12 @@ from bench import measure_decode
 def main() -> None:
     from edl_tpu.models import llama
 
+    from bench import flagship_decode_config
+
     on_tpu = jax.devices()[0].platform == "tpu"
     if on_tpu:
-        cfg = llama.LlamaConfig(
-            vocab=32768, d_model=2048, n_layers=16, n_heads=16,
-            n_kv_heads=8, d_ff=6144, dtype=jnp.bfloat16, use_flash=True,
-        )
-        ladder = [(1, 512, 64), (8, 512, 64), (32, 512, 64)]
+        cfg = flagship_decode_config()
+        ladder = [(1, 512, 128), (8, 512, 128), (32, 512, 128)]
     else:  # smoke
         cfg = llama.LlamaConfig.tiny(vocab=512)
         ladder = [(2, 32, 8)]

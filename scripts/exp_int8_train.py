@@ -108,11 +108,7 @@ def flagship_rates():
     mesh = plan.build()
     rng = np.random.RandomState(0)
     if on_tpu:
-        cfg = llama.LlamaConfig(
-            vocab=32768, d_model=2048, n_layers=16, n_heads=16,
-            n_kv_heads=8, d_ff=6144, dtype=jnp.bfloat16, use_flash=True,
-            remat=True,
-        )
+        cfg = bench.flagship_train_config()
         lt, ladder, lsteps, lreps = 2048, (16, 8), 2, 4
     else:  # smoke
         cfg = llama.LlamaConfig.tiny(vocab=512)
@@ -148,13 +144,11 @@ def loss_tracking(steps=30):
         TrainState, global_batch, make_train_step, shard_state,
     )
 
+    import bench
+
     on_tpu = jax.devices()[0].platform == "tpu"
     if on_tpu:
-        cfg = llama.LlamaConfig(
-            vocab=32768, d_model=2048, n_layers=16, n_heads=16,
-            n_kv_heads=8, d_ff=6144, dtype=jnp.bfloat16, use_flash=True,
-            remat=True,
-        )
+        cfg = bench.flagship_train_config()
         b, t = 8, 2048
     else:
         cfg = llama.LlamaConfig.tiny(vocab=512)
