@@ -420,7 +420,13 @@ def _p2p_bench() -> dict:
 
 def _peak_hbm_bw(device) -> float:
     """Per-chip HBM bandwidth by device kind (bytes/s). Decode is
-    BW-bound, so this is the denominator of its roofline."""
+    BW-bound, so this is the denominator of its roofline.
+
+    Note: the B=1 decode rung has measured slightly ABOVE 1.0
+    pct-of-peak on the bench chip (reported as "TPU v5 lite"), i.e.
+    this table's spec value is conservative for that part. The table
+    stays as-spec for cross-round comparability — read pct-of-peak as
+    a relative efficiency index, not a physical bound."""
     kind = getattr(device, "device_kind", "").lower()
     if "v5 lite" in kind or "v5e" in kind or "v5lite" in kind:
         return 819e9
