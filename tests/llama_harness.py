@@ -1,0 +1,43 @@
+"""Shared llama parity harness (NOT a test module — safe to import as
+``tests.llama_harness`` from any test file without the double-import
+footgun of importing one test module from another)."""
+
+import dataclasses
+
+import jax
+import numpy as np
+import optax
+
+from edl_tpu.models import llama
+from edl_tpu.train.trainer import (
+    TrainState,
+    global_batch,
+    make_train_step,
+    shard_state,
+)
+
+
+def loss_curve(plan, cfg=None, n_batches=3, **cfg_overrides):
+    """Train the tiny llama for a few SGD steps under ``plan`` and
+    return the loss curve — the parity harness for every strategy mesh
+    (a layout choice must not change the math)."""
+    cfg = cfg or llama.LlamaConfig.tiny()
+    if cfg_overrides:
+        cfg = dataclasses.replace(cfg, **cfg_overrides)
+    batches = [
+        llama.synthetic_tokens(np.random.RandomState(i), 8, 16, cfg.vocab)
+        for i in range(n_batches)
+    ]
+    mesh = plan.build()
+    params = llama.init_params(jax.random.PRNGKey(1), cfg)
+    tx = optax.sgd(1e-2)
+    pspecs = llama.param_pspecs(cfg, plan)
+    state = shard_state(TrainState.create(params, tx), plan, mesh, pspecs)
+    step = make_train_step(
+        llama.make_loss_fn(cfg, plan, mesh), tx, plan, mesh, pspecs
+    )
+    out = []
+    for b in batches:
+        state, m = step(state, global_batch(b, plan, mesh))
+        out.append(float(m["loss"]))
+    return out

@@ -209,3 +209,20 @@ def test_generate_strips_int8_mxu():
     got = np.asarray(llama.generate(params, prompt, cfg_q, max_new=6))
     want = np.asarray(llama.generate(params, prompt, cfg, max_new=6))
     np.testing.assert_array_equal(got, want)
+
+
+def test_int8_mxu_pp_matches_dp(cpu_devices):
+    """int8 under pipeline parallelism: a pp=2 int8 run must match a
+    dp-only int8 run — the mesh layout must not change the quantized
+    math. Tolerance is looser than the bf16 parity tests: a reduction-
+    order difference that lands an operand exactly on a round()
+    boundary shifts that value by its quantization step (absmax/127),
+    which the exact-f32 tests never see."""
+    import dataclasses
+
+    from tests.llama_harness import loss_curve
+
+    cfg = dataclasses.replace(llama.LlamaConfig.tiny(), int8_mxu=True)
+    l_dp = loss_curve(MeshPlan.data_parallel(8), cfg=cfg)
+    l_pp = loss_curve(MeshPlan.create(dp=4, pp=2), cfg=cfg)
+    np.testing.assert_allclose(l_pp, l_dp, rtol=5e-3, atol=5e-4)

@@ -80,32 +80,10 @@ def test_tp_matches_unsharded(cpu_devices):
     np.testing.assert_allclose(l_tp, l_dp, rtol=1e-4, atol=1e-5)
 
 
-def _loss_curve(plan, cfg=None, n_batches=3, **cfg_overrides):
-    """Train the tiny llama for a few SGD steps under ``plan`` and
-    return the loss curve — the parity harness for every strategy mesh
-    (a layout choice must not change the math)."""
-    import dataclasses
-
-    cfg = cfg or llama.LlamaConfig.tiny()
-    if cfg_overrides:
-        cfg = dataclasses.replace(cfg, **cfg_overrides)
-    batches = [
-        llama.synthetic_tokens(np.random.RandomState(i), 8, 16, cfg.vocab)
-        for i in range(n_batches)
-    ]
-    mesh = plan.build()
-    params = llama.init_params(jax.random.PRNGKey(1), cfg)
-    tx = optax.sgd(1e-2)
-    pspecs = llama.param_pspecs(cfg, plan)
-    state = shard_state(TrainState.create(params, tx), plan, mesh, pspecs)
-    step = make_train_step(
-        llama.make_loss_fn(cfg, plan, mesh), tx, plan, mesh, pspecs
-    )
-    out = []
-    for b in batches:
-        state, m = step(state, global_batch(b, plan, mesh))
-        out.append(float(m["loss"]))
-    return out
+from tests.llama_harness import loss_curve as _loss_curve  # noqa: E402
+# (shared with test_int8_matmul.py via the non-test-module pattern —
+# importing one test module from another double-imports it under
+# pytest's prepend import mode)
 
 
 def test_sp_ring_matches_dp(cpu_devices):
