@@ -524,14 +524,33 @@ def run_generate(args) -> int:
         # weight-only int8: halves decode's weight-bandwidth bill
         # (models/llama.py quantize_params_int8; bench decode_int8_*)
         params = jax.jit(llama.quantize_params_int8)(params)
-    toks = llama.generate(
-        params,
-        prompt,
-        cfg,
-        max_new=args.max_new,
-        temperature=args.temperature,
-        key=jax.random.PRNGKey(args.seed) if args.temperature > 0 else None,
-    )
+    if args.temperature <= 0 and (args.top_k or args.top_p < 1.0):
+        # greedy ignores the sampling filters — error rather than
+        # silently printing greedy tokens the user believes are sampled
+        print(
+            "--top-k/--top-p require --temperature > 0 "
+            "(greedy decoding ignores them)",
+            file=sys.stderr,
+        )
+        return 1
+    try:
+        toks = llama.generate(
+            params,
+            prompt,
+            cfg,
+            max_new=args.max_new,
+            temperature=args.temperature,
+            key=(
+                jax.random.PRNGKey(args.seed)
+                if args.temperature > 0
+                else None
+            ),
+            top_k=args.top_k,
+            top_p=args.top_p,
+        )
+    except ValueError as e:  # bad top_k/top_p bounds
+        print(str(e), file=sys.stderr)
+        return 1
     print(",".join(str(int(t)) for t in np.asarray(toks)[0]))
     return 0
 
@@ -756,6 +775,15 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--max-new", type=int, default=16)
     g.add_argument("--temperature", type=float, default=0.0)
     g.add_argument("--seed", type=int, default=0)
+    g.add_argument(
+        "--top-k", type=int, default=0,
+        help="sample from the k most likely tokens (0 = no truncation)",
+    )
+    g.add_argument(
+        "--top-p", type=float, default=1.0,
+        help="nucleus sampling: smallest token set with probability "
+        "mass >= p (1.0 = off); composes with --top-k",
+    )
     g.add_argument(
         "--mesh",
         default="",

@@ -597,19 +597,31 @@ def generate(
     max_new: int,
     temperature: float = 0.0,
     key: Optional[jax.Array] = None,
+    top_k: int = 0,
+    top_p: float = 1.0,
 ) -> jnp.ndarray:
     """Autoregressive generation from a prompt [B, T0] → [B, max_new].
 
     Greedy at ``temperature == 0`` (the default), categorical sampling
-    otherwise (``key`` required). One jit per (shape, cfg): prefill +
-    a ``lax.scan`` decode loop over positions with the KV cache as
-    carry. Accepts params straight from ``runtime.export.load_export``
-    (cast float leaves to ``cfg.dtype``-compatible types first if the
-    export was bf16 and you want f32 math)."""
+    otherwise (``key`` required), with the standard serving controls:
+    ``top_k > 0`` restricts sampling to the k most likely tokens,
+    ``top_p < 1`` to the smallest nucleus whose probability mass
+    reaches p (the first token always stays eligible). Both compose
+    (k-truncate, then nucleus within it). One jit per (shape, cfg,
+    top_k, top_p-active): prefill + a ``lax.scan`` decode loop over
+    positions with the KV cache as carry; temperature and p are traced
+    scalars (sweeping them costs zero recompiles). Accepts params
+    straight from ``runtime.export.load_export`` (cast float leaves to
+    ``cfg.dtype``-compatible types first if the export was bf16 and
+    you want f32 math)."""
     if temperature > 0 and key is None:
         raise ValueError("sampling (temperature > 0) needs a PRNG key")
     if max_new < 1:
         raise ValueError(f"max_new must be >= 1, got {max_new}")
+    if top_k < 0 or top_k > cfg.vocab:
+        raise ValueError(f"top_k must be in [0, vocab], got {top_k}")
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     if cfg.int8_mxu:
         # training-only throughput flag: left on it would dynamically
         # quantize SOME decode matmuls (the _qkv/_mlp shared ones) but
@@ -619,12 +631,15 @@ def generate(
 
         cfg = dataclasses.replace(cfg, int8_mxu=False)
     b, t0 = tokens.shape
-    run = _generate_program(cfg, b, t0, int(max_new), temperature > 0)
+    run = _generate_program(
+        cfg, b, t0, int(max_new), temperature > 0, int(top_k), top_p < 1.0
+    )
     return run(
         params,
         tokens,
         key if key is not None else jax.random.PRNGKey(0),
         jnp.float32(temperature if temperature > 0 else 1.0),
+        jnp.float32(top_p),
     )
 
 
@@ -632,12 +647,14 @@ _generate_programs: Dict = {}
 
 
 def _generate_program(cfg: LlamaConfig, b: int, t0: int, max_new: int,
-                      sampling: bool):
-    """Memoized jit program per (cfg, shapes, greedy-vs-sampling) —
-    repeat generate() calls reuse the compiled prefill+decode scan
-    instead of re-tracing (a full-size model pays minutes per compile).
-    Temperature is a TRACED scalar: sweeping it costs zero recompiles."""
-    cache_key = (cfg, b, t0, max_new, sampling)
+                      sampling: bool, top_k: int, use_top_p: bool):
+    """Memoized jit program per (cfg, shapes, greedy-vs-sampling,
+    top_k, top_p-active) — repeat generate() calls reuse the compiled
+    prefill+decode scan instead of re-tracing (a full-size model pays
+    minutes per compile). Temperature and the nucleus threshold are
+    TRACED scalars: sweeping them costs zero recompiles; only the
+    top_k VALUE is static (it sets the truncated shape)."""
+    cache_key = (cfg, b, t0, max_new, sampling, top_k, use_top_p)
     run = _generate_programs.get(cache_key)
     if run is not None:
         return run
@@ -645,16 +662,32 @@ def _generate_program(cfg: LlamaConfig, b: int, t0: int, max_new: int,
     max_len = t0 + max_new
 
     @jax.jit
-    def run(params, tokens, key, temperature):
+    def run(params, tokens, key, temperature, top_p):
         logits, ks, vs = _prefill(params, tokens, cfg)
         pad = jnp.zeros((L, b, max_len - t0, kvh, hd), ks.dtype)
         kc = jnp.concatenate([ks, pad], axis=2)
         vc = jnp.concatenate([vs, pad], axis=2)
 
         def sample(logits, k):
-            if sampling:
+            if not sampling:
+                return jnp.argmax(logits, axis=-1)
+            if not top_k and not use_top_p:
                 return jax.random.categorical(k, logits / temperature, axis=-1)
-            return jnp.argmax(logits, axis=-1)
+            # truncate to the top-m subspace (descending), sample the
+            # INDEX within it, then map back through the gathered ids —
+            # nucleus filtering only ever sees the sorted tail
+            m = top_k if top_k else logits.shape[-1]
+            vals, idx = jax.lax.top_k(logits, m)  # [B, m] descending
+            scaled = vals / temperature
+            if use_top_p:
+                probs = jax.nn.softmax(scaled, axis=-1)
+                # exclusive cumulative mass: the first token's mass is
+                # 0, so it is always eligible (top_p -> 0 degenerates
+                # to greedy, never to an empty support)
+                cum = jnp.cumsum(probs, axis=-1) - probs
+                scaled = jnp.where(cum < top_p, scaled, -jnp.inf)
+            j = jax.random.categorical(k, scaled, axis=-1)
+            return jnp.take_along_axis(idx, j[:, None], axis=-1)[:, 0]
 
         def step(carry, i):
             logits, kc, vc, k = carry

@@ -226,3 +226,17 @@ def test_int8_mxu_pp_matches_dp(cpu_devices):
     l_dp = loss_curve(MeshPlan.data_parallel(8), cfg=cfg)
     l_pp = loss_curve(MeshPlan.create(dp=4, pp=2), cfg=cfg)
     np.testing.assert_allclose(l_pp, l_dp, rtol=5e-3, atol=5e-4)
+
+
+def test_int8_mxu_sp_matches_dp(cpu_devices):
+    """int8 under sequence parallelism (ring attention inside
+    shard_map): same layout-invariance contract as the pp test, same
+    round()-boundary tolerance rationale."""
+    import dataclasses
+
+    from tests.llama_harness import loss_curve
+
+    cfg = dataclasses.replace(llama.LlamaConfig.tiny(), int8_mxu=True)
+    l_dp = loss_curve(MeshPlan.data_parallel(8), cfg=cfg)
+    l_sp = loss_curve(MeshPlan.create(dp=4, sp=2), cfg=cfg)
+    np.testing.assert_allclose(l_sp, l_dp, rtol=5e-3, atol=5e-4)

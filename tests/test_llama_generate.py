@@ -312,3 +312,123 @@ def test_cli_generate_int8(tmp_path):
     )
     assert both.returncode == 1
     assert "mutually exclusive" in both.stderr
+
+
+# -- top-k / top-p sampling controls ----------------------------------------
+
+
+def test_top_k_one_equals_greedy():
+    """top_k=1 collapses sampling to greedy regardless of key/temp."""
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jnp.asarray([[3, 5, 7, 9]], jnp.int32)
+    greedy = llama.generate(params, prompt, cfg, max_new=6)
+    for seed in (0, 7):
+        sampled = llama.generate(
+            params, prompt, cfg, max_new=6, temperature=1.5,
+            key=jax.random.PRNGKey(seed), top_k=1,
+        )
+        np.testing.assert_array_equal(np.asarray(sampled), np.asarray(greedy))
+
+
+def test_tiny_top_p_equals_greedy():
+    """top_p -> 0 keeps only the most likely token (the exclusive-
+    cumsum construction never empties the support)."""
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(jax.random.PRNGKey(1), cfg)
+    prompt = jnp.asarray([[2, 4, 6]], jnp.int32)
+    greedy = llama.generate(params, prompt, cfg, max_new=5)
+    nucleus = llama.generate(
+        params, prompt, cfg, max_new=5, temperature=1.0,
+        key=jax.random.PRNGKey(3), top_p=1e-6,
+    )
+    np.testing.assert_array_equal(np.asarray(nucleus), np.asarray(greedy))
+
+
+def test_top_k_restricts_support():
+    """Every sampled token must come from the step's top-k logits:
+    verified by replaying the sampled prefix through forward and
+    checking membership at each position."""
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(jax.random.PRNGKey(2), cfg)
+    prompt = np.asarray([[1, 2, 3, 4]], np.int32)
+    k = 5
+    toks = np.asarray(
+        llama.generate(
+            params, jnp.asarray(prompt), cfg, max_new=6, temperature=2.0,
+            key=jax.random.PRNGKey(9), top_k=k,
+        )
+    )
+    seq = prompt
+    for t in range(toks.shape[1]):
+        logits = np.asarray(llama.forward(params, jnp.asarray(seq), cfg))
+        topk_ids = np.argsort(logits[0, -1])[::-1][:k]
+        assert toks[0, t] in topk_ids, (t, toks[0, t], topk_ids)
+        seq = np.concatenate([seq, toks[:, t : t + 1]], axis=1)
+
+
+def test_cli_generate_top_flags(tmp_path):
+    import os
+    import subprocess
+    import sys
+
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    export_params(
+        str(tmp_path), params, step=1, dtype="float32",
+        model_meta=cfg.to_meta(),
+    )
+    env = {
+        **os.environ,
+        "PYTHONPATH": os.path.dirname(os.path.dirname(__file__)),
+        "JAX_PLATFORMS": "cpu",
+    }
+    out = subprocess.run(
+        [
+            sys.executable, "-m", "edl_tpu.cli", "generate", str(tmp_path),
+            "--prompt", "1,2,3", "--max-new", "4", "--temperature", "0.9",
+            "--top-k", "8", "--top-p", "0.9",
+        ],
+        capture_output=True, text=True, env=env,
+    )
+    assert out.returncode == 0, out.stderr
+    assert len(out.stdout.strip().split(",")) == 4
+
+    bad = subprocess.run(
+        [
+            sys.executable, "-m", "edl_tpu.cli", "generate", str(tmp_path),
+            "--prompt", "1,2", "--max-new", "2", "--top-p", "1.5",
+        ],
+        capture_output=True, text=True, env=env,
+    )
+    assert bad.returncode == 1
+    assert "top_p" in bad.stderr
+
+
+def test_cli_top_flags_require_temperature(tmp_path):
+    """Greedy decoding ignores the sampling filters — the CLI errors
+    instead of silently printing greedy tokens."""
+    import os
+    import subprocess
+    import sys
+
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    export_params(
+        str(tmp_path), params, step=1, dtype="float32",
+        model_meta=cfg.to_meta(),
+    )
+    env = {
+        **os.environ,
+        "PYTHONPATH": os.path.dirname(os.path.dirname(__file__)),
+        "JAX_PLATFORMS": "cpu",
+    }
+    out = subprocess.run(
+        [
+            sys.executable, "-m", "edl_tpu.cli", "generate", str(tmp_path),
+            "--prompt", "1,2", "--max-new", "2", "--top-k", "5",
+        ],
+        capture_output=True, text=True, env=env,
+    )
+    assert out.returncode == 1
+    assert "--temperature > 0" in out.stderr
