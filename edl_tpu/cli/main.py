@@ -451,6 +451,21 @@ def run_generate(args) -> int:
         load_export_sharded,
     )
 
+    # argv-only validation FIRST: a pure flag mistake must not cost a
+    # multi-GB export load + quantization before it is reported
+    if args.temperature <= 0 and (args.top_k or args.top_p < 1.0):
+        print(
+            "--top-k/--top-p require --temperature > 0 "
+            "(greedy decoding ignores them)",
+            file=sys.stderr,
+        )
+        return 1
+    if args.top_k < 0:
+        print(f"top_k must be >= 0, got {args.top_k}", file=sys.stderr)
+        return 1
+    if not 0.0 < args.top_p <= 1.0:
+        print(f"top_p must be in (0, 1], got {args.top_p}", file=sys.stderr)
+        return 1
     doc = export_status(args.export_dir)
     if doc is None:
         print(f"no published export under {args.export_dir}", file=sys.stderr)
@@ -524,15 +539,6 @@ def run_generate(args) -> int:
         # weight-only int8: halves decode's weight-bandwidth bill
         # (models/llama.py quantize_params_int8; bench decode_int8_*)
         params = jax.jit(llama.quantize_params_int8)(params)
-    if args.temperature <= 0 and (args.top_k or args.top_p < 1.0):
-        # greedy ignores the sampling filters — error rather than
-        # silently printing greedy tokens the user believes are sampled
-        print(
-            "--top-k/--top-p require --temperature > 0 "
-            "(greedy decoding ignores them)",
-            file=sys.stderr,
-        )
-        return 1
     try:
         toks = llama.generate(
             params,

@@ -48,14 +48,20 @@ class MoEConfig:
     dtype: Any = jnp.bfloat16
     use_flash: bool = False
     remat: bool = False
-
+    # run the attention projections + expert batched matmuls on the
+    # MXU's double-rate int8 path (training-only, same contract as
+    # LlamaConfig.int8_mxu: weights at rest stay dense, the flag never
+    # rides the export record)
+    int8_mxu: bool = False
 
     def to_meta(self) -> dict:
         """JSON-safe architecture record for export manifests
         (the one shared rule: models/meta.py)."""
         from edl_tpu.models.meta import dataclass_meta
 
-        return dataclass_meta(self, "moe")
+        meta = dataclass_meta(self, "moe")
+        meta.pop("int8_mxu")  # training-only: never a load contract
+        return meta
 
     @classmethod
     def from_meta(cls, meta: dict) -> "MoEConfig":
@@ -178,25 +184,28 @@ def _layer(cfg: MoEConfig, x: jnp.ndarray, lp: Dict):
     dt = x.dtype
     b, t, d = x.shape
     h, kv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    # attention block — llama's, verbatim building blocks
+    i8 = cfg.int8_mxu
+    # attention block — llama's, verbatim building blocks (_matw
+    # routes through the int8 MXU path when the flag is set)
     a = _ll._rmsnorm(x, lp["ln1"], cfg.norm_eps)
-    q = (a @ lp["wq"].astype(dt)).reshape(b, t, h, hd)
-    k = (a @ lp["wk"].astype(dt)).reshape(b, t, kv, hd)
-    v = (a @ lp["wv"].astype(dt)).reshape(b, t, kv, hd)
+    q = _ll._matw(a, lp["wq"], i8).reshape(b, t, h, hd)
+    k = _ll._matw(a, lp["wk"], i8).reshape(b, t, kv, hd)
+    v = _ll._matw(a, lp["wv"], i8).reshape(b, t, kv, hd)
     q, k = _ll._rope(q, cfg.rope_theta), _ll._rope(k, cfg.rope_theta)
     o = _ll.attention(q, k, v, lcfg).reshape(b, t, h * hd)
-    x = x + o @ lp["wo"].astype(dt)
+    x = x + _ll._matw(o, lp["wo"], i8)
     # routed expert FFN
     m = _ll._rmsnorm(x, lp["ln2"], cfg.norm_eps)
     y, aux = moe_ffn(
         {
             "router": lp["router"].astype(dt),
-            "w_in": lp["w_in"].astype(dt),
-            "w_out": lp["w_out"].astype(dt),
+            "w_in": lp["w_in"] if i8 else lp["w_in"].astype(dt),
+            "w_out": lp["w_out"] if i8 else lp["w_out"].astype(dt),
         },
         m,
         k=cfg.top_k,
         capacity_factor=cfg.capacity_factor,
+        int8_mxu=i8,
     )
     return x + y, aux
 

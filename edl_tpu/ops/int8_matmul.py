@@ -96,3 +96,17 @@ def _bwd(res, g):
 
 
 int8_matmul.defvjp(_fwd, _bwd)
+
+
+def int8_batched_matmul(a: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    """Batched ``a @ w`` on the int8 MXU path with STE gradients — the
+    expert-parallel twin of :func:`int8_matmul` (MoE expert FFNs are
+    [E, C, K] x [E, K, N] batched matmuls; `parallel/moe.py`).
+
+    Just a vmap of the 2D op: per expert slice that IS the identical
+    recipe (per-row/per-column absmax along each dot's contraction
+    axis, fresh scales for dgrad/wgrad), and a hand-written batched
+    twin would be a second quantizer copy to drift — XLA lowers the
+    vmapped dots to the same batched int8 dot_general.
+    """
+    return jax.vmap(int8_matmul)(a, w)

@@ -48,11 +48,17 @@ def moe_ffn(
     x: jnp.ndarray,
     k: int = 2,
     capacity_factor: float = 1.25,
+    int8_mxu: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Top-k routed expert FFN. x [B, T, D] → (y [B, T, D], aux_loss).
 
     aux_loss is the standard load-balance loss (mean_prob · mean_assign
     · n_experts), to be added to the training loss.
+
+    ``int8_mxu`` runs the two expert batched matmuls on the MXU's
+    double-rate int8 path (ops/int8_matmul.int8_batched_matmul) —
+    the routing/dispatch einsums stay full precision (they are
+    bandwidth-shaped one-hot contractions, not FLOPs).
     """
     b, t, d = x.shape
     n_tokens = b * t
@@ -94,8 +100,16 @@ def moe_ffn(
 
     # expert compute: [E, C, D] batched matmuls (MXU-friendly)
     expert_in = jnp.einsum("nec,nd->ecd", dispatch, flat)
-    h = jax.nn.relu(jnp.einsum("ecd,edf->ecf", expert_in, params["w_in"]))
-    expert_out = jnp.einsum("ecf,efd->ecd", h, params["w_out"])
+    if int8_mxu:
+        from edl_tpu.ops.int8_matmul import int8_batched_matmul
+
+        h = jax.nn.relu(int8_batched_matmul(expert_in, params["w_in"]))
+        expert_out = int8_batched_matmul(h, params["w_out"])
+    else:
+        h = jax.nn.relu(
+            jnp.einsum("ecd,edf->ecf", expert_in, params["w_in"])
+        )
+        expert_out = jnp.einsum("ecf,efd->ecd", h, params["w_out"])
     y = jnp.einsum("nec,ecd->nd", weights, expert_out)
 
     # load-balance auxiliary loss

@@ -89,11 +89,12 @@ class WorkerConfig:
     # contends with the training step loop for HBM.
     eval_max_rows: int = 4096
     eval_device: str = ""
-    # llama workload: run the projection matmuls on the MXU's
-    # double-rate int8 path (ops/int8_matmul.py — dynamic absmax both
-    # operands, STE gradients; +12% flagship throughput, loss tracks
-    # bf16 within noise, doc/design.md "Int8 MXU training"). Exports
-    # and checkpoints are unaffected: weights at rest stay dense.
+    # llama/moe workloads: run the projection (and MoE expert) matmuls
+    # on the MXU's double-rate int8 path (ops/int8_matmul.py — dynamic
+    # absmax both operands, STE gradients; +12% flagship throughput,
+    # loss tracks bf16 within noise, doc/design.md "Int8 MXU
+    # training"). Exports and checkpoints are unaffected: weights at
+    # rest stay dense.
     int8_mxu: bool = False
     # TPU slice this host belongs to (multi-slice topology). -1 =
     # unknown: the mesh build falls back to the hardware's own

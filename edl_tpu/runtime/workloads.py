@@ -240,11 +240,15 @@ def _moe_workload(cfg: WorkerConfig) -> Workload:
     """Mixture-of-Experts decoder under elastic DPxEP (no reference
     analog — SURVEY §2.5 "Expert parallelism: NO"; mesh "ep=2,dp"
     pins the expert axis while dp absorbs membership change)."""
+    import dataclasses
+
     import jax
 
     from edl_tpu.models import moe
 
-    mcfg = moe.MoEConfig.tiny(vocab=cfg.vocab)
+    mcfg = dataclasses.replace(
+        moe.MoEConfig.tiny(vocab=cfg.vocab), int8_mxu=cfg.int8_mxu
+    )
 
     def batch_fn(start: int, end: int) -> Dict[str, np.ndarray]:
         r = np.random.RandomState(cfg.seed * 1_000_003 + start + 1)
