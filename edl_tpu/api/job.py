@@ -200,6 +200,13 @@ class TrainingJobSpec:
     # under a volume mount — workers then train on real files through
     # the lease queue instead of synthetic batches
     data_dir: str = ""
+    # extra worker environment (the runtime's EDL_* contract beyond
+    # what the parser derives: EDL_MODEL, EDL_SYNC_EVERY, EDL_P2P,
+    # EDL_EVAL_DIR, EDL_INT8_MXU, ... — worker_config.py is the full
+    # list). Derived contract keys always win over these (validate()
+    # warns on the collision); accepts a mapping or the k8s
+    # [{name, value}] list form in YAML.
+    env: Dict[str, str] = field(default_factory=dict)
     # pod volumes + mounts (reference: types.go:54-56) — how real jobs
     # see datasets and checkpoint stores
     volumes: List[VolumeSpec] = field(default_factory=list)
@@ -232,6 +239,46 @@ class TrainingJobStatus:
     # reshards that fell back to host-RAM staging (the slow path whose
     # worst case doc/reshard_stall.md bounds) — a monitor alarm signal
     reshard_fallbacks: int = 0
+
+
+def _env_value(v) -> str:
+    """YAML scalar -> the EDL_* contract's string form. Booleans map to
+    the contract's "1"/"0" — str(False) would be "False", which e.g.
+    worker_config's ``!= "0"`` / ``== "1"`` checks silently misread
+    (EDL_P2P: false would leave p2p ON)."""
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    return str(v)
+
+
+def _parse_env(raw) -> Dict[str, str]:
+    """spec.env from YAML: a plain mapping, or the k8s container-style
+    ``[{name, value}]`` list (what users paste from pod specs — but
+    ONLY that shape: ``valueFrom`` etc. are hard errors, not silent
+    empty strings). Scalars stringify (``EDL_INT8_MXU: 1`` -> "1",
+    booleans -> "1"/"0")."""
+    if not raw:
+        return {}
+    if isinstance(raw, list):
+        out: Dict[str, str] = {}
+        for e in raw:
+            if (
+                not isinstance(e, dict)
+                or not e.get("name")
+                or set(e) - {"name", "value"}
+            ):
+                raise ValueError(
+                    "env list entries must be exactly {name, value} "
+                    f"(k8s valueFrom etc. are not supported), got {e!r}"
+                )
+            out[str(e["name"])] = _env_value(e.get("value", ""))
+        return out
+    if isinstance(raw, dict):
+        return {str(k): _env_value(v) for k, v in raw.items()}
+    raise ValueError(
+        f"spec.env must be a mapping or a [{{name, value}}] list, "
+        f"got {type(raw).__name__}"
+    )
 
 
 def qualify(namespace: str, name: str) -> str:
@@ -338,6 +385,7 @@ class TrainingJob:
             checkpoint_dir=spec_d.get("checkpoint_dir", ""),
             checkpoint_every=int(spec_d.get("checkpoint_every", 0)),
             data_dir=spec_d.get("data_dir", ""),
+            env=_parse_env(spec_d.get("env")),
             volumes=[
                 VolumeSpec(
                     name=v.get("name", ""),
@@ -424,6 +472,8 @@ class TrainingJob:
             spec["checkpoint_every"] = s.checkpoint_every
         if s.data_dir:
             spec["data_dir"] = s.data_dir
+        if s.env:
+            spec["env"] = dict(s.env)
         if s.volumes:
             spec["volumes"] = [
                 {"name": v.name, **v.source} for v in s.volumes

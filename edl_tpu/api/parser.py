@@ -125,6 +125,16 @@ class JobParser:
                 "pserver group is ignored on TPU: parameter/optimizer state is "
                 "sharded in-mesh (FSDP); remove spec.pserver"
             )
+        if s.env:
+            # keys the parser derives always win over spec.env — flag
+            # the collision instead of silently dropping the user value
+            shadowed = sorted(set(s.env) & set(self._derived_env(job)))
+            if shadowed:
+                warnings.append(
+                    f"spec.env keys {shadowed} are derived by the parser "
+                    "and will be overridden; set them through the spec "
+                    "fields instead"
+                )
         mesh_total = 1
         for v in s.mesh.axis_sizes().values():
             mesh_total *= v
@@ -223,7 +233,19 @@ class JobParser:
         """Env-var contract injected into every worker
         (reference: podEnv pkg/jobparser.go:263-311). TPU renames:
         EDL_* replaces PADDLE_INIT_*; the coordinator address replaces
-        etcd discovery."""
+        etcd discovery.
+
+        ``spec.env`` rides underneath: the per-job runtime knobs the
+        parser does NOT derive (EDL_MODEL, EDL_SYNC_EVERY, EDL_P2P*,
+        EDL_EVAL_*, EDL_INT8_MXU, ...). Derived keys always win — a
+        manifest overriding EDL_WORKERS_MIN would desync the
+        autoscaler from the runtime (validate() warns on collisions).
+        """
+        return {**job.spec.env, **self._derived_env(job)}
+
+    def _derived_env(self, job: TrainingJob) -> Dict[str, str]:
+        """The contract keys the parser itself derives from the spec —
+        the reserved set spec.env can never override."""
         s = job.spec
         return {
             "EDL_JOB_NAME": job.name,

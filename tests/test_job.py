@@ -149,3 +149,82 @@ def test_parse_plans():
     assert env["EDL_WORKERS_MAX"] == "10"
     assert env["EDL_FAULT_TOLERANT"] == "1"
     assert "example-coordinator" in env["EDL_COORDINATOR"]
+
+
+def test_spec_env_passthrough():
+    """spec.env carries the runtime's EDL_* knobs (EDL_MODEL,
+    EDL_INT8_MXU, ...) into the worker env; derived contract keys
+    always win and the collision warns; both YAML shapes parse and
+    values stringify; to_dict round-trips."""
+    job = TrainingJob.from_dict({
+        "metadata": {"name": "envjob"},
+        "spec": {
+            "fault_tolerant": True,
+            "env": {
+                "EDL_MODEL": "llama",
+                "EDL_INT8_MXU": 1,       # YAML int -> "1"
+                "EDL_WORKERS_MIN": "99",  # reserved: must be shadowed
+            },
+            "worker": {"min_replicas": 2, "max_replicas": 4},
+        },
+    })
+    p = JobParser()
+    warnings = p.validate(job)
+    assert any("EDL_WORKERS_MIN" in w for w in warnings)
+    env = p.parse_to_workers(job).env
+    assert env["EDL_MODEL"] == "llama"
+    assert env["EDL_INT8_MXU"] == "1"
+    assert env["EDL_WORKERS_MIN"] == "2"  # the derived contract won
+
+    # k8s container-style list form
+    j2 = TrainingJob.from_dict({
+        "metadata": {"name": "e2"},
+        "spec": {
+            "env": [{"name": "EDL_SYNC_EVERY", "value": "4"}],
+            "worker": {"min_replicas": 1},
+        },
+    })
+    assert j2.spec.env == {"EDL_SYNC_EVERY": "4"}
+    assert TrainingJob.from_dict(j2.to_dict()).spec.env == j2.spec.env
+
+    # malformed shapes are hard errors, not silent drops
+    with pytest.raises(ValueError):
+        TrainingJob.from_dict(
+            {"metadata": {"name": "b"}, "spec": {"env": [{"value": "x"}]}}
+        )
+    with pytest.raises(ValueError):
+        TrainingJob.from_dict(
+            {"metadata": {"name": "b"}, "spec": {"env": "EDL_MODEL=llama"}}
+        )
+
+
+def test_spec_env_bool_and_valuefrom_handling():
+    """YAML booleans normalize to the contract's "1"/"0" (str(False)
+    would silently misread as enabled downstream); k8s valueFrom
+    entries are hard errors, not silent empty strings."""
+    j = TrainingJob.from_dict({
+        "metadata": {"name": "b"},
+        "spec": {
+            "env": {"EDL_P2P": False, "EDL_INT8_MXU": True},
+            "worker": {"min_replicas": 1},
+        },
+    })
+    assert j.spec.env == {"EDL_P2P": "0", "EDL_INT8_MXU": "1"}
+    j2 = TrainingJob.from_dict({
+        "metadata": {"name": "b2"},
+        "spec": {
+            "env": [{"name": "EDL_INT8_MXU", "value": True}],
+            "worker": {"min_replicas": 1},
+        },
+    })
+    assert j2.spec.env == {"EDL_INT8_MXU": "1"}
+    with pytest.raises(ValueError):
+        TrainingJob.from_dict({
+            "metadata": {"name": "b3"},
+            "spec": {
+                "env": [{
+                    "name": "EDL_MODEL",
+                    "valueFrom": {"configMapKeyRef": {"name": "cm"}},
+                }],
+            },
+        })

@@ -704,3 +704,20 @@ def test_watch_recovers_from_mid_stream_410(server, cluster):
     )
     assert src._watch_healthy()
     src.close()
+
+
+def test_spec_env_rendered_into_worker_pods(server, cluster):
+    """spec.env rides into the worker Job's container env (underneath
+    the derived contract) — how a cluster job turns on EDL_INT8_MXU,
+    picks EDL_MODEL, etc."""
+    job = _job(name="enveee")
+    job.spec.env = {"EDL_MODEL": "llama", "EDL_INT8_MXU": "1"}
+    cluster.create_worker_group(JobParser().parse_to_workers(job))
+    obj = server.get_object("batch/v1", "jobs", "default", "enveee-worker")
+    env = {
+        e["name"]: e["value"]
+        for e in obj["spec"]["template"]["spec"]["containers"][0]["env"]
+    }
+    assert env["EDL_MODEL"] == "llama"
+    assert env["EDL_INT8_MXU"] == "1"
+    assert env["EDL_JOB_NAME"] == "enveee"  # contract still present
