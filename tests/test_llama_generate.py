@@ -432,3 +432,28 @@ def test_cli_top_flags_require_temperature(tmp_path):
     )
     assert out.returncode == 1
     assert "--temperature > 0" in out.stderr
+
+
+def test_int8_records_compose_with_sampling_controls():
+    """The two serving features compose: weight-only int8 records +
+    top-k/top-p sampling in one generate call (the `edl generate
+    --int8 --top-k ...` path). top_k=1 through the records must equal
+    int8 greedy."""
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(jax.random.PRNGKey(6), cfg)
+    qp = llama.quantize_params_int8(params)
+    prompt = jnp.asarray([[4, 8, 15]], jnp.int32)
+
+    greedy_q = llama.generate(qp, prompt, cfg, max_new=5)
+    pick1 = llama.generate(
+        qp, prompt, cfg, max_new=5, temperature=1.3,
+        key=jax.random.PRNGKey(2), top_k=1,
+    )
+    np.testing.assert_array_equal(np.asarray(pick1), np.asarray(greedy_q))
+
+    sampled = llama.generate(
+        qp, prompt, cfg, max_new=5, temperature=0.9,
+        key=jax.random.PRNGKey(2), top_k=8, top_p=0.9,
+    )
+    assert sampled.shape == (1, 5)
+    assert ((np.asarray(sampled) >= 0) & (np.asarray(sampled) < cfg.vocab)).all()
