@@ -436,20 +436,80 @@ def run_job_status(args) -> int:
         cl.close()
 
 
-def run_generate(args) -> int:
-    """Decode from a published export — the serving consumer in one
-    command (export manifest carries the architecture record; llama
-    KV-cache decode does the rest). ``--mesh "tp=2"`` loads the params
-    SHARDED onto a device mesh (the training layout reused for
-    serving), so exports bigger than one chip's HBM serve at all.
-    Imports jax lazily: every other CLI verb stays device-free."""
-    import numpy as np
-
+def _load_llama_serving(export_dir: str, mesh_arg: str, int8: bool):
+    """Load a published llama export for a decoding consumer — shared
+    by ``edl generate`` and ``edl serve``. ``mesh_arg`` (MeshPlan
+    grammar) loads the params SHARDED with the training layout so
+    exports bigger than one chip's HBM serve at all; ``int8`` quantizes
+    to the weight-only records. Returns (params, cfg) or (None, errmsg)
+    — the caller prints errmsg and exits 1. Imports jax lazily so the
+    device-free CLI verbs never pull it in."""
     from edl_tpu.runtime.export import (
         export_status,
         load_export,
         load_export_sharded,
     )
+
+    doc = export_status(export_dir)
+    if doc is None:
+        return None, f"no published export under {export_dir}"
+    model = doc.get("model") or {}
+    if model.get("family") != "llama":
+        return None, (
+            f"export has no llama architecture record "
+            f"(model={model or None}); re-export with model_meta "
+            f"(LlamaConfig.to_meta())"
+        )
+    if int8 and mesh_arg:
+        # the int8 records carry no pspecs; sharded serving keeps the
+        # training layout instead of re-deriving one for q8/s8 — and
+        # the check must precede the (multi-GB) load it would waste
+        return None, "--int8 and --mesh are mutually exclusive"
+    import jax
+
+    from edl_tpu.models import llama
+
+    if mesh_arg:
+        from edl_tpu.parallel.mesh import MeshPlan
+
+        try:
+            plan = MeshPlan.parse(mesh_arg, len(jax.devices()))
+            mesh = plan.build()
+        except ValueError as e:
+            return None, f"bad --mesh {mesh_arg!r}: {e}"
+        # pspecs derived from the SAME manifest the params load from —
+        # a publish landing mid-call cannot pair one export's config
+        # with another's weights
+        try:
+            params, doc = load_export_sharded(
+                export_dir,
+                mesh,
+                lambda d: llama.param_pspecs(
+                    llama.LlamaConfig.from_meta(d["model"]), plan
+                ),
+            )
+        except ValueError as e:  # raced into a non-llama export
+            return None, f"export changed mid-load: {e}"
+        print(f"# mesh {plan.describe()}", file=sys.stderr)
+    else:
+        params, doc = load_export(export_dir)
+    try:
+        cfg = llama.LlamaConfig.from_meta(doc.get("model") or {})
+    except ValueError as e:
+        return None, f"export changed mid-load: {e}"
+    if int8:
+        # weight-only int8: halves decode's weight-bandwidth bill
+        # (models/llama.py quantize_params_int8; bench decode_int8_*)
+        params = jax.jit(llama.quantize_params_int8)(params)
+    return params, cfg
+
+
+def run_generate(args) -> int:
+    """Decode from a published export — the one-shot serving consumer
+    (export manifest carries the architecture record; llama KV-cache
+    decode does the rest). Loading (sharded / int8) is shared with
+    ``edl serve`` via ``_load_llama_serving``."""
+    import numpy as np
 
     # argv-only validation FIRST: a pure flag mistake must not cost a
     # multi-GB export load + quantization before it is reported
@@ -466,60 +526,17 @@ def run_generate(args) -> int:
     if not 0.0 < args.top_p <= 1.0:
         print(f"top_p must be in (0, 1], got {args.top_p}", file=sys.stderr)
         return 1
-    doc = export_status(args.export_dir)
-    if doc is None:
-        print(f"no published export under {args.export_dir}", file=sys.stderr)
+    params, cfg_or_err = _load_llama_serving(
+        args.export_dir, args.mesh, args.int8
+    )
+    if params is None:
+        print(cfg_or_err, file=sys.stderr)
         return 1
-    model = doc.get("model") or {}
-    if model.get("family") != "llama":
-        print(
-            f"export has no llama architecture record "
-            f"(model={model or None}); re-export with model_meta "
-            f"(LlamaConfig.to_meta())",
-            file=sys.stderr,
-        )
-        return 1
-    if args.int8 and args.mesh:
-        # the int8 records carry no pspecs; sharded serving keeps the
-        # training layout instead of re-deriving one for q8/s8 — and
-        # the check must precede the (multi-GB) load it would waste
-        print("--int8 and --mesh are mutually exclusive", file=sys.stderr)
-        return 1
+    cfg = cfg_or_err
     import jax
 
     from edl_tpu.models import llama
 
-    if args.mesh:
-        from edl_tpu.parallel.mesh import MeshPlan
-
-        try:
-            plan = MeshPlan.parse(args.mesh, len(jax.devices()))
-            mesh = plan.build()
-        except ValueError as e:
-            print(f"bad --mesh {args.mesh!r}: {e}", file=sys.stderr)
-            return 1
-        # pspecs derived from the SAME manifest the params load from —
-        # a publish landing mid-call cannot pair one export's config
-        # with another's weights
-        try:
-            params, doc = load_export_sharded(
-                args.export_dir,
-                mesh,
-                lambda d: llama.param_pspecs(
-                    llama.LlamaConfig.from_meta(d["model"]), plan
-                ),
-            )
-        except ValueError as e:  # raced into a non-llama export
-            print(f"export changed mid-load: {e}", file=sys.stderr)
-            return 1
-        print(f"# mesh {plan.describe()}", file=sys.stderr)
-    else:
-        params, doc = load_export(args.export_dir)
-    try:
-        cfg = llama.LlamaConfig.from_meta(doc.get("model") or {})
-    except ValueError as e:
-        print(f"export changed mid-load: {e}", file=sys.stderr)
-        return 1
     try:
         ids = [int(t) for t in args.prompt.split(",")]
     except ValueError:
@@ -535,10 +552,6 @@ def run_generate(args) -> int:
     if (prompt < 0).any() or (prompt >= cfg.vocab).any():
         print(f"prompt tokens outside [0, {cfg.vocab})", file=sys.stderr)
         return 1
-    if args.int8:
-        # weight-only int8: halves decode's weight-bandwidth bill
-        # (models/llama.py quantize_params_int8; bench decode_int8_*)
-        params = jax.jit(llama.quantize_params_int8)(params)
     try:
         toks = llama.generate(
             params,
@@ -558,6 +571,146 @@ def run_generate(args) -> int:
         print(str(e), file=sys.stderr)
         return 1
     print(",".join(str(int(t)) for t in np.asarray(toks)[0]))
+    return 0
+
+
+def _read_serve_requests(path: str, default_max_new: int, default_eos):
+    """Parse the ``edl serve`` JSONL request feed (``-`` = stdin):
+    one object per line, ``{"prompt": [ids], "id"?, "max_new"?,
+    "eos"?}``. Returns a list of dicts or raises ValueError — parsed
+    BEFORE the export loads, so a malformed feed never costs a multi-GB
+    load."""
+    if path == "-":
+        lines = sys.stdin.read().splitlines()
+    else:
+        with open(path) as f:
+            lines = f.read().splitlines()
+    out = []
+    for i, line in enumerate(lines):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"line {i + 1}: not JSON ({e})")
+        if not isinstance(obj, dict) or "prompt" not in obj:
+            raise ValueError(f'line {i + 1}: need an object with "prompt"')
+        prompt = obj["prompt"]
+        if not isinstance(prompt, list) or not all(
+            isinstance(t, int) for t in prompt
+        ):
+            raise ValueError(f"line {i + 1}: prompt must be a list of ints")
+        eos = obj.get("eos", default_eos)
+        out.append(
+            {
+                "id": str(obj.get("id", f"req-{i + 1}")),
+                "prompt": prompt,
+                "max_new": int(obj.get("max_new", default_max_new)),
+                "eos": None if eos is None or int(eos) < 0 else int(eos),
+            }
+        )
+    if not out:
+        raise ValueError("no requests in the feed")
+    return out
+
+
+def run_serve(args) -> int:
+    """Continuous-batching serving from a published export: requests
+    from a JSONL file (or stdin) flow through the admission-controlled
+    queue into the slot-table engine (edl_tpu/serving/), which batches
+    every in-flight request into one decode program. Completed requests
+    print as JSONL on stdout (submit order); serving metrics (TTFT,
+    tokens/s, queue depth, slot occupancy) render through the monitor
+    collector on stderr. Composes with the existing export paths:
+    ``--int8`` weight-only records, ``--mesh`` sharded loading."""
+    # argv-only validation FIRST (same contract as run_generate)
+    if args.temperature < 0:
+        print(f"temperature must be >= 0, got {args.temperature}",
+              file=sys.stderr)
+        return 1
+    if args.max_slots < 1:
+        print(f"--max-slots must be >= 1, got {args.max_slots}",
+              file=sys.stderr)
+        return 1
+    if args.max_len < 2:
+        print(f"--max-len must be >= 2, got {args.max_len}", file=sys.stderr)
+        return 1
+    try:
+        requests = _read_serve_requests(
+            args.requests, args.max_new,
+            None if args.eos < 0 else args.eos,
+        )
+    except (OSError, ValueError) as e:
+        print(f"bad request feed: {e}", file=sys.stderr)
+        return 1
+    params, cfg_or_err = _load_llama_serving(
+        args.export_dir, args.mesh, args.int8
+    )
+    if params is None:
+        print(cfg_or_err, file=sys.stderr)
+        return 1
+    cfg = cfg_or_err
+
+    from edl_tpu.monitor.collector import Collector, ServingSource
+    from edl_tpu.serving import (
+        AdmissionError,
+        InterleavePolicy,
+        RequestQueue,
+        ServingMetrics,
+    )
+    from edl_tpu.serving.engine import ContinuousBatchingEngine
+
+    queue = RequestQueue(
+        max_total_len=args.max_len,
+        max_depth=args.max_queue,
+        max_prompt_len=args.max_prompt,
+        max_new_cap=args.max_new_cap,
+    )
+    metrics = ServingMetrics()
+    engine = ContinuousBatchingEngine(
+        params, cfg,
+        max_slots=args.max_slots,
+        max_len=args.max_len,
+        queue=queue,
+        metrics=metrics,
+        policy=InterleavePolicy(prefills_per_step=args.prefills_per_step),
+        temperature=args.temperature,
+        seed=args.seed,
+    )
+    collector = Collector(ServingSource(metrics), out=sys.stderr)
+
+    rejected = {}
+    for r in requests:
+        try:
+            engine.submit(r["id"], r["prompt"], r["max_new"], r["eos"])
+        except AdmissionError as e:
+            rejected[r["id"]] = e
+            log.warn("request rejected", rid=r["id"], reason=e.reason)
+    steps = 0
+    while engine.has_work:
+        engine.step()
+        steps += 1
+        if args.metrics_every and steps % args.metrics_every == 0:
+            print(collector.poll().render(), file=sys.stderr, flush=True)
+    for r in requests:
+        rid = r["id"]
+        if rid in rejected:
+            e = rejected[rid]
+            rec = {"id": rid, "outcome": f"rejected:{e.reason}",
+                   "error": str(e)}
+        else:
+            res = engine.results[rid]
+            stats = metrics.request_stats(rid)
+            rec = {
+                "id": rid,
+                "tokens": res.tokens,
+                "outcome": res.outcome,
+                "ttft_s": round(stats["ttft_s"], 6),
+                "tokens_per_s": round(stats["tokens_per_s"], 3),
+            }
+        print(json.dumps(rec))
+    print(collector.poll().render(), file=sys.stderr)
     return 0
 
 
@@ -805,6 +958,69 @@ def build_parser() -> argparse.ArgumentParser:
         "the weight-bandwidth bill of small-batch decode",
     )
     g.set_defaults(fn=run_generate)
+
+    sv = sub.add_parser(
+        "serve",
+        help="continuous-batching serving from a published llama export "
+        "(JSONL requests in, JSONL completions out, metrics on stderr)",
+    )
+    sv.add_argument("export_dir")
+    sv.add_argument(
+        "--requests", default="-",
+        help='JSONL request feed, one {"prompt": [ids], "id"?, '
+        '"max_new"?, "eos"?} per line ("-" = stdin)',
+    )
+    sv.add_argument(
+        "--max-slots", type=int, default=8,
+        help="KV decode slots = the continuous batch width",
+    )
+    sv.add_argument(
+        "--max-len", type=int, default=256,
+        help="tokens per KV slot (prompt + generated must fit)",
+    )
+    sv.add_argument(
+        "--max-queue", type=int, default=64,
+        help="admission control: max queued requests",
+    )
+    sv.add_argument(
+        "--max-prompt", type=int, default=0,
+        help="admission control: max prompt tokens (0 = max-len - 1)",
+    )
+    sv.add_argument(
+        "--max-new-cap", type=int, default=0,
+        help="admission control: per-request token budget cap (0 = off)",
+    )
+    sv.add_argument(
+        "--max-new", type=int, default=16,
+        help="default token budget for requests that omit max_new",
+    )
+    sv.add_argument(
+        "--eos", type=int, default=-1,
+        help="default EOS token id stopping decode early (-1 = none)",
+    )
+    sv.add_argument(
+        "--prefills-per-step", type=int, default=1,
+        help="prefill/decode interleave: queue pops admitted between "
+        "consecutive batched decode steps",
+    )
+    sv.add_argument("--temperature", type=float, default=0.0)
+    sv.add_argument("--seed", type=int, default=0)
+    sv.add_argument(
+        "--metrics-every", type=int, default=0,
+        help="render serving metrics to stderr every N engine steps "
+        "(0 = final summary only)",
+    )
+    sv.add_argument(
+        "--mesh", default="",
+        help='serve sharded: MeshPlan grammar (e.g. "tp=2") — the '
+        "training layout reused, as in `edl generate`",
+    )
+    sv.add_argument(
+        "--int8", action="store_true",
+        help="weight-only int8 decode (per-output-column absmax "
+        "records), as in `edl generate`",
+    )
+    sv.set_defaults(fn=run_serve)
 
     pr = sub.add_parser(
         "predict",

@@ -220,14 +220,18 @@ def _rope(
 ) -> jnp.ndarray:
     """Rotary embedding over [B, T, H, hd]. ``positions`` [T] overrides
     the default 0..T-1 (the decode path rotates single tokens at their
-    absolute position)."""
+    absolute position); a [B, T] positions array rotates each batch row
+    at its OWN absolute positions (the continuous-batching slot decode,
+    where concurrent requests sit at different depths)."""
     _, t, _, hd = x.shape
     freqs = 1.0 / (theta ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd))
     if positions is None:
         positions = jnp.arange(t, dtype=jnp.float32)
-    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # [T, hd/2]
-    cos = jnp.cos(angles)[None, :, None, :].astype(x.dtype)
-    sin = jnp.sin(angles)[None, :, None, :].astype(x.dtype)
+    angles = positions.astype(jnp.float32)[..., :, None] * freqs  # [..., T, hd/2]
+    if angles.ndim == 2:
+        angles = angles[None]  # shared positions broadcast over B
+    cos = jnp.cos(angles)[:, :, None, :].astype(x.dtype)
+    sin = jnp.sin(angles)[:, :, None, :].astype(x.dtype)
     x1, x2 = jnp.split(x, 2, axis=-1)
     return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
 
@@ -299,7 +303,11 @@ def _matw(a: jnp.ndarray, p, int8_mxu: bool = False) -> jnp.ndarray:
     (``ops/int8_matmul.py``) — a throughput lever, not a memory one."""
     dt = a.dtype
     if isinstance(p, dict):
-        return (a @ p["q8"].astype(dt)) * p["s8"].astype(dt)
+        # the column-scale multiply stays f32: casting s8 to bf16 first
+        # would truncate each scale to an 8-bit mantissa, stacking up to
+        # ~0.2% systematic error on top of the colmax/254 quantization
+        # bound (ADVICE r5)
+        return ((a @ p["q8"].astype(dt)).astype(jnp.float32) * p["s8"]).astype(dt)
     if int8_mxu:
         from edl_tpu.ops.int8_matmul import int8_matmul
 
@@ -590,6 +598,84 @@ def _decode_step(params: Dict, tok: jnp.ndarray, pos, kc, vc, cfg: LlamaConfig):
     return logits, kc, vc
 
 
+def prefill_padded(params: Dict, tokens: jnp.ndarray, last, cfg: LlamaConfig):
+    """Prefill over an END-padded prompt batch [B, Tb], returning the
+    logits at each row's ``last`` index (its final REAL token) plus the
+    K/V cache [L, B, Tb, KV, hd].
+
+    Causality makes end-padding invisible to every real position: pad
+    rows attend backward into the prompt but no real row ever attends
+    forward into a pad, so logits and cache rows at positions <= last
+    are exactly an unpadded prefill's. This is what lets the serving
+    engine prefill mixed-length prompts into a handful of power-of-two
+    buckets — O(log max_prompt) compiled programs instead of one per
+    prompt length. ``last`` is a traced scalar or [B] vector, so every
+    length inside a bucket reuses one program."""
+    b = tokens.shape[0]
+    x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
+
+    def body(carry, lp):
+        y, k, v = _layer(cfg, carry, lp, with_kv=True)
+        return y, (k, v)
+
+    x, (ks, vs) = jax.lax.scan(body, x, params["layers"])
+    x = _rmsnorm(x, params["ln_f"], cfg.norm_eps)
+    xl = x[jnp.arange(b), last]  # [B, d] — each row's last real token
+    logits = _matw(xl, params["lm_head"]).astype(jnp.float32)
+    return logits, ks, vs
+
+
+def decode_step_slots(
+    params: Dict,
+    tok: jnp.ndarray,
+    pos: jnp.ndarray,
+    kc: jnp.ndarray,
+    vc: jnp.ndarray,
+    cfg: LlamaConfig,
+):
+    """One continuous-batching decode step over B independent KV slots.
+    tok [B] int32 (each slot's previous token); pos [B] int32 (the
+    cache position each slot writes this step); kc/vc [L, B, S, KV, hd].
+    Returns (logits [B, V], kc, vc).
+
+    Per-row math is IDENTICAL to :func:`_decode_step` — same unrolled
+    layer loop, shared ``_qkv``/``_mlp``, the same GQA-grouped cached
+    attention — except positions, cache writes, and the causal mask are
+    per-row, so requests at different depths decode in one batched step
+    (the serving engine's slot table, ``edl_tpu/serving/engine.py``).
+    The cache write is a per-row scatter at (row, pos[row]) — unique
+    indices, so XLA keeps it in place like the dynamic_update_slice of
+    the uniform-position path. Rows the caller considers inactive
+    should be fed (tok=0, pos=0) and their outputs ignored: they
+    re-write slot position 0 each step, which the next prefill-insert
+    overwrites before it is ever unmasked."""
+    b = tok.shape[0]
+    h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    groups = h // kvh
+    s = kc.shape[2]
+    rows = jnp.arange(b)
+    x = jnp.take(params["embed"], tok[:, None], axis=0).astype(cfg.dtype)
+    for i in range(cfg.n_layers):
+        lp = jax.tree_util.tree_map(lambda a: a[i], params["layers"])
+        dt = x.dtype
+        a = _rmsnorm(x, lp["ln1"], cfg.norm_eps)
+        q, knew, vnew = _qkv(cfg, a, lp, pos[:, None])
+        kc = kc.at[i, rows, pos].set(knew[:, 0])
+        vc = vc.at[i, rows, pos].set(vnew[:, 0])
+        kci, vci = kc[i], vc[i]  # static-index slices of the carry
+        qg = q.reshape(b, 1, kvh, groups, hd)
+        scores = jnp.einsum("btkgd,bskd->bkgts", qg, kci) / np.sqrt(hd)
+        mask = (jnp.arange(s)[None, :] <= pos[:, None])[:, None, None, None, :]
+        scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dt)
+        o = jnp.einsum("bkgts,bskd->btkgd", probs, vci).reshape(b, 1, h * hd)
+        x = x + _matw(o, lp["wo"])
+        x = _mlp(cfg, x, lp)
+    x = _rmsnorm(x, params["ln_f"], cfg.norm_eps)
+    logits = _matw(x[:, 0], params["lm_head"]).astype(jnp.float32)
+    return logits, kc, vc
+
+
 def generate(
     params: Dict,
     tokens: jnp.ndarray,
@@ -616,6 +702,14 @@ def generate(
     you want f32 math)."""
     if temperature > 0 and key is None:
         raise ValueError("sampling (temperature > 0) needs a PRNG key")
+    if temperature <= 0 and (top_k or top_p < 1.0):
+        # greedy argmax ignores the sampling filters — raising mirrors
+        # the CLI's rejection so library callers get the same signal
+        # instead of silently-inert arguments (ADVICE r5)
+        raise ValueError(
+            "top_k/top_p require temperature > 0 "
+            "(greedy decoding ignores them)"
+        )
     if max_new < 1:
         raise ValueError(f"max_new must be >= 1, got {max_new}")
     if top_k < 0 or top_k > cfg.vocab:

@@ -44,6 +44,10 @@ class MonitorSample:
     cpu_request_milli: int = 0
     chip_total: int = 0
     chip_request: int = 0
+    # serving-engine load (ServingSource / ServingMetrics.snapshot) —
+    # empty for training-fleet samples. Same plumbing as training load
+    # so an autoscaler can consume either.
+    serving: Dict[str, float] = field(default_factory=dict)
 
     @property
     def cpu_util(self) -> float:
@@ -59,7 +63,13 @@ class MonitorSample:
         return 100.0 * self.chip_request / self.chip_total
 
     def render(self) -> str:
-        """Text block in the reference collector's table style."""
+        """Text block in the reference collector's table style. A
+        serving-only sample (ServingSource: no fleet census at all)
+        renders just its SERVING block."""
+        if self.serving and not (
+            self.submitted_jobs or self.chip_total or self.cpu_total_milli
+        ):
+            return "\n".join(self._serving_lines())
         lines = [
             f"SUBMITTED-JOBS: {len(self.submitted_jobs)}",
             f"PENDING-JOBS: {len(self.pending_jobs)}"
@@ -89,7 +99,27 @@ class MonitorSample:
             f"CHIP-UTILS: {self.chip_util:.2f}% "
             f"({self.chip_request}/{self.chip_total})"
         )
+        if self.serving:
+            lines.extend(self._serving_lines())
         return "\n".join(lines)
+
+    def _serving_lines(self) -> List[str]:
+        s = self.serving
+        return [
+            "SERVING: "
+            f"queue={s.get('queue_depth', 0):.0f} "
+            f"active={s.get('active_slots', 0):.0f}"
+            f"/{s.get('max_slots', 0):.0f} "
+            f"occupancy={100.0 * s.get('slot_occupancy', 0.0):.1f}% "
+            f"ttft_avg={s.get('ttft_avg_s', 0.0):.3f}s "
+            f"tokens/s={s.get('agg_tokens_per_s', 0.0):.1f}",
+            "  requests: "
+            f"submitted={s.get('submitted', 0):.0f} "
+            f"admitted={s.get('admitted', 0):.0f} "
+            f"rejected={s.get('rejected', 0):.0f} "
+            f"completed={s.get('completed', 0):.0f} "
+            f"tokens={s.get('tokens_out', 0):.0f}",
+        ]
 
 
 class ClusterSource:
@@ -147,6 +177,24 @@ class StoreSource:
             s.reshards[name] = st.get("reshard_count", 0)
             s.last_stall_s[name] = st.get("last_reshard_stall_s", 0.0)
             s.reshard_fallbacks[name] = st.get("reshard_fallbacks", 0)
+        return s
+
+
+class ServingSource:
+    """Sample a serving engine's :class:`~edl_tpu.serving.metrics.
+    ServingMetrics` — serving load through the SAME collector plumbing
+    as training load, so the autoscaler can later consume either. Takes
+    the metrics object itself (or any zero-arg callable returning a
+    snapshot dict), keeping this module jax-free."""
+
+    def __init__(self, metrics):
+        self._snapshot = (
+            metrics if callable(metrics) else metrics.snapshot
+        )
+
+    def sample(self) -> MonitorSample:
+        s = MonitorSample(ts=time.time())
+        s.serving = dict(self._snapshot())
         return s
 
 

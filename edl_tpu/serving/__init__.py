@@ -1,0 +1,33 @@
+"""Continuous-batching serving engine.
+
+``scheduler`` (admission-controlled FIFO) and ``metrics`` (TTFT /
+tokens/s / occupancy) are jax-free and imported eagerly; the engine
+itself pulls in jax, so it loads lazily — control-plane code (the CLI's
+device-free verbs) can import this package without touching a device.
+"""
+
+from edl_tpu.serving.metrics import ServingMetrics
+from edl_tpu.serving.scheduler import (
+    AdmissionError,
+    InterleavePolicy,
+    Request,
+    RequestQueue,
+)
+
+__all__ = [
+    "AdmissionError",
+    "ContinuousBatchingEngine",
+    "InterleavePolicy",
+    "Request",
+    "RequestQueue",
+    "RequestResult",
+    "ServingMetrics",
+]
+
+
+def __getattr__(name):
+    if name in ("ContinuousBatchingEngine", "RequestResult"):
+        from edl_tpu.serving import engine
+
+        return getattr(engine, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,353 @@
+"""Continuous-batching generation engine — a slot-table decode loop
+over the llama KV-cache path.
+
+The decode roofline is HBM-bound and batch-sensitive (BENCH_r05: 0.73
+of roofline at B=1 vs 0.93 at B=32): a one-request-at-a-time server
+streams the full weight set per token for ONE token. This engine keeps
+a fixed table of ``max_slots`` KV slots and decodes every active slot
+in one batched step, prefill-inserting new requests into free slots and
+evicting finished ones BETWEEN steps — requests are the elastic
+membership, and the decode program never changes shape while they come
+and go.
+
+jit stability across membership changes is the design center, mirroring
+``llama._generate_program``:
+
+* ONE compiled decode program per (cfg, max_slots, max_len, sampling) —
+  ``llama.decode_step_slots`` with per-row positions/masks, so a join
+  or evict changes host-side bookkeeping only, never the program;
+* O(log max_prompt) compiled prefill programs — prompts pad into
+  power-of-two buckets and ``llama.prefill_padded`` takes the real
+  length as a traced scalar (causality makes end-padding invisible);
+  the prefill program also scatters the new K/V into the slot row and
+  samples the first token, so admission is one dispatch;
+* programs are memoized at module level (like ``_generate_programs``),
+  so engines are cheap to construct and tests/harnesses reuse compiles.
+
+Greedy decode (temperature == 0, the default) is token-identical to
+sequential ``llama.generate`` per request — the correctness contract
+``tests/test_serving.py`` pins, including mid-stream join/evict.
+Temperature sampling is supported but uses the engine's own per-step
+key schedule (a batched server cannot replay ``generate``'s per-request
+key walk).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from edl_tpu.models import llama
+from edl_tpu.serving.metrics import ServingMetrics
+from edl_tpu.serving.scheduler import (
+    AdmissionError,
+    InterleavePolicy,
+    Request,
+    RequestQueue,
+)
+from edl_tpu.utils.logging import kv_logger
+
+log = kv_logger("serving")
+
+_programs: Dict = {}
+
+
+def _memo(key, make):
+    fn = _programs.get(key)
+    if fn is None:
+        if len(_programs) > 128:
+            _programs.clear()
+        fn = _programs[key] = make()
+    return fn
+
+
+def _decode_program(cfg: llama.LlamaConfig, b: int, s: int, sampling: bool):
+    """(params, tok [B], pos [B], kc, vc, key, temperature) ->
+    (next_tok [B], kc, vc). The single program every membership
+    composition runs."""
+
+    def make():
+        @jax.jit
+        def run(params, tok, pos, kc, vc, key, temperature):
+            logits, kc, vc = llama.decode_step_slots(
+                params, tok, pos, kc, vc, cfg
+            )
+            if sampling:
+                nxt = jax.random.categorical(key, logits / temperature, axis=-1)
+            else:
+                nxt = jnp.argmax(logits, axis=-1)
+            return nxt.astype(jnp.int32), kc, vc
+
+        return run
+
+    return _memo(("decode", cfg, b, s, sampling), make)
+
+
+def _prefill_program(cfg: llama.LlamaConfig, tb: int, sampling: bool):
+    """(params, tokens [1, Tb], last, kc, vc, slot, key, temperature)
+    -> (first_tok [1], kc, vc): prefill one padded prompt, scatter its
+    K/V into cache row ``slot``, emit the first generated token — one
+    dispatch per admission. ``last`` and ``slot`` are traced, so one
+    program serves every (length, slot) inside the bucket."""
+
+    def make():
+        @jax.jit
+        def run(params, tokens, last, kc, vc, slot, key, temperature):
+            logits, ks, vs = llama.prefill_padded(params, tokens, last, cfg)
+            kc = jax.lax.dynamic_update_slice(kc, ks, (0, slot, 0, 0, 0))
+            vc = jax.lax.dynamic_update_slice(vc, vs, (0, slot, 0, 0, 0))
+            if sampling:
+                tok = jax.random.categorical(key, logits / temperature, axis=-1)
+            else:
+                tok = jnp.argmax(logits, axis=-1)
+            return tok.astype(jnp.int32), kc, vc
+
+        return run
+
+    return _memo(("prefill", cfg, tb, sampling), make)
+
+
+@dataclass
+class _Slot:
+    """Host-side state of one occupied KV slot."""
+
+    rid: str
+    pos: int  # cache position the NEXT decode step writes
+    max_new: int
+    eos_id: Optional[int]
+    generated: List[int] = field(default_factory=list)
+
+
+@dataclass
+class RequestResult:
+    rid: str
+    tokens: List[int]
+    outcome: str  # done | eos
+
+
+class ContinuousBatchingEngine:
+    """In-process continuous-batching server over a llama param tree.
+
+    ``params`` is anything ``llama.generate`` accepts: a dense export
+    tree (``load_export``), a sharded one (``load_export_sharded``), or
+    the weight-only int8 records (``quantize_params_int8``). The KV
+    cache is [L, max_slots, max_len, KV, hd] in ``cfg.dtype`` — sized
+    once, reused forever.
+
+    Drive it with :meth:`submit` + :meth:`step` (one admit/decode
+    iteration — the soak harness interleaves arrivals here) or
+    :meth:`run` (drain everything). Completed requests land in
+    ``results`` and the metrics hooks fire along the way.
+    """
+
+    def __init__(
+        self,
+        params: Any,
+        cfg: llama.LlamaConfig,
+        *,
+        max_slots: int = 8,
+        max_len: int = 256,
+        queue: Optional[RequestQueue] = None,
+        metrics: Optional[ServingMetrics] = None,
+        policy: Optional[InterleavePolicy] = None,
+        temperature: float = 0.0,
+        seed: int = 0,
+        min_bucket: int = 8,
+        clock=time.monotonic,
+    ):
+        if max_slots < 1:
+            raise ValueError(f"max_slots must be >= 1, got {max_slots}")
+        if temperature < 0:
+            raise ValueError(f"temperature must be >= 0, got {temperature}")
+        self.params = params
+        self.cfg = cfg
+        self.max_slots = max_slots
+        self.max_len = max_len
+        self.queue = queue or RequestQueue(max_total_len=max_len, clock=clock)
+        if self.queue.max_total_len > max_len:
+            raise ValueError(
+                f"queue admits up to {self.queue.max_total_len} total "
+                f"tokens but KV slots hold {max_len}"
+            )
+        self.metrics = metrics or ServingMetrics(clock=clock)
+        self.policy = policy or InterleavePolicy()
+        self.temperature = float(temperature)
+        self.min_bucket = min_bucket
+        self.results: Dict[str, RequestResult] = {}
+        self._sampling = self.temperature > 0
+        self._key = jax.random.PRNGKey(seed)
+        self._slots: List[Optional[_Slot]] = [None] * max_slots
+        self._tok = np.zeros(max_slots, np.int32)
+        self._pos = np.zeros(max_slots, np.int32)
+        L, kvh, hd = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+        shape = (L, max_slots, max_len, kvh, hd)
+        self._kc = jnp.zeros(shape, cfg.dtype)
+        self._vc = jnp.zeros(shape, cfg.dtype)
+        self._decode = _decode_program(cfg, max_slots, max_len, self._sampling)
+        log.info(
+            "engine ready",
+            slots=max_slots,
+            max_len=max_len,
+            cache_mb=round(2 * np.prod(shape) * np.dtype(cfg.dtype).itemsize
+                           / 2**20, 1),
+            sampling=self._sampling,
+        )
+
+    # -- request intake -----------------------------------------------------
+
+    def submit(
+        self,
+        rid: str,
+        prompt: List[int],
+        max_new: int,
+        eos_id: Optional[int] = None,
+    ) -> None:
+        """Queue a request; raises :class:`AdmissionError` (and counts
+        the rejection) when admission control refuses it."""
+        self.metrics.on_submit(rid)
+        if rid in self.results or any(
+            s is not None and s.rid == rid for s in self._slots
+        ):
+            self.metrics.on_reject(rid, "bad_request")
+            raise AdmissionError("bad_request", f"duplicate request id {rid!r}")
+        bad = [t for t in prompt if not 0 <= int(t) < self.cfg.vocab]
+        if bad:
+            self.metrics.on_reject(rid, "bad_request")
+            raise AdmissionError(
+                "bad_request",
+                f"{rid}: prompt tokens {bad[:4]} outside [0, {self.cfg.vocab})",
+            )
+        try:
+            self.queue.submit(
+                Request(rid=rid, prompt=list(map(int, prompt)),
+                        max_new=int(max_new), eos_id=eos_id)
+            )
+        except AdmissionError as e:
+            self.metrics.on_reject(rid, e.reason)
+            raise
+
+    # -- the engine loop ----------------------------------------------------
+
+    @property
+    def active_slots(self) -> int:
+        return sum(1 for s in self._slots if s is not None)
+
+    @property
+    def has_work(self) -> bool:
+        return self.active_slots > 0 or self.queue.depth > 0
+
+    def step(self) -> int:
+        """One engine iteration: admit up to the interleave budget of
+        queued requests into free slots (prefill-insert), then run ONE
+        batched decode step over every active slot. Returns tokens
+        emitted this iteration (prefill first-tokens included)."""
+        emitted = self._admit()
+        active = [i for i, s in enumerate(self._slots) if s is not None]
+        self.metrics.on_step(len(active), self.max_slots, self.queue.depth)
+        if not active:
+            return emitted
+        tok, self._kc, self._vc = self._decode(
+            self.params,
+            jnp.asarray(self._tok),
+            jnp.asarray(self._pos),
+            self._kc,
+            self._vc,
+            self._next_key(),
+            jnp.float32(self.temperature if self._sampling else 1.0),
+        )
+        out = np.asarray(tok)
+        for i in active:
+            sl = self._slots[i]
+            t = int(out[i])
+            sl.generated.append(t)
+            sl.pos += 1
+            self._tok[i] = t
+            self._pos[i] = sl.pos
+            self.metrics.on_token(sl.rid)
+            emitted += 1
+            if sl.eos_id is not None and t == sl.eos_id:
+                self._finish(i, "eos")
+            elif len(sl.generated) >= sl.max_new:
+                self._finish(i, "done")
+        return emitted
+
+    def run(self, max_steps: Optional[int] = None) -> Dict[str, RequestResult]:
+        """Drain queue + slots (or stop after ``max_steps``)."""
+        steps = 0
+        while self.has_work and (max_steps is None or steps < max_steps):
+            self.step()
+            steps += 1
+        return dict(self.results)
+
+    # -- internals ----------------------------------------------------------
+
+    def _next_key(self):
+        if not self._sampling:
+            return self._key  # untraced constant path, never consumed
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def _bucket(self, n: int) -> int:
+        b = self.min_bucket
+        while b < n:
+            b *= 2
+        return min(b, self.max_len)
+
+    def _admit(self) -> int:
+        free = [i for i, s in enumerate(self._slots) if s is None]
+        budget = self.policy.budget(len(free), self.queue.depth)
+        emitted = 0
+        for _ in range(budget):
+            req = self.queue.pop()
+            if req is None:
+                break
+            slot = free.pop(0)
+            t0 = len(req.prompt)
+            tb = self._bucket(t0)
+            toks = np.zeros((1, tb), np.int32)
+            toks[0, :t0] = req.prompt
+            prefill = _prefill_program(self.cfg, tb, self._sampling)
+            tok0, self._kc, self._vc = prefill(
+                self.params,
+                jnp.asarray(toks),
+                jnp.int32(t0 - 1),
+                self._kc,
+                self._vc,
+                jnp.int32(slot),
+                self._next_key(),
+                jnp.float32(self.temperature if self._sampling else 1.0),
+            )
+            tok0 = int(np.asarray(tok0)[0])
+            self.metrics.on_admit(req.rid, t0)
+            sl = _Slot(
+                rid=req.rid, pos=t0, max_new=req.max_new,
+                eos_id=req.eos_id, generated=[tok0],
+            )
+            self._slots[slot] = sl
+            self._tok[slot] = tok0
+            self._pos[slot] = t0
+            self.metrics.on_token(req.rid)
+            emitted += 1
+            if sl.eos_id is not None and tok0 == sl.eos_id:
+                self._finish(slot, "eos")
+            elif sl.max_new <= 1:
+                self._finish(slot, "done")
+        return emitted
+
+    def _finish(self, slot: int, outcome: str) -> None:
+        sl = self._slots[slot]
+        self.results[sl.rid] = RequestResult(
+            rid=sl.rid, tokens=list(sl.generated), outcome=outcome
+        )
+        self.metrics.on_finish(sl.rid, outcome)
+        # eviction is bookkeeping only: the freed cache row is dead
+        # weight until the next prefill-insert overwrites it, and the
+        # decode program never changes shape
+        self._slots[slot] = None
+        self._tok[slot] = 0
+        self._pos[slot] = 0

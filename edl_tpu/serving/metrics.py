@@ -1,0 +1,150 @@
+"""Serving metrics — TTFT, tokens/s, queue depth, slot occupancy,
+request outcome counters.
+
+The training side publishes load through ``monitor/collector.py`` so
+the autoscaler can act on it; serving publishes through the SAME
+plumbing (``monitor.collector.ServingSource`` wraps
+:meth:`ServingMetrics.snapshot`), so a future autoscaler consumes
+serving load exactly like training load. Pure host bookkeeping — the
+engine calls the ``on_*`` hooks from its step loop; nothing here
+touches jax. ``clock`` is injectable so tests are deterministic.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import Counter
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+
+@dataclass
+class _ReqRecord:
+    submit_s: float = 0.0
+    admit_s: float = 0.0
+    first_token_s: float = 0.0
+    finish_s: float = 0.0
+    prompt_len: int = 0
+    tokens: int = 0
+    outcome: str = ""  # done | eos | rejected:<reason>
+
+
+class ServingMetrics:
+    """Aggregates one engine's serving telemetry.
+
+    Counters: submitted / admitted / rejected (by reason) / completed
+    (by outcome) / tokens_out. Gauges: queue depth, active slots, slot
+    occupancy (mean active/max over decode steps). Latency: per-request
+    TTFT (first generated token, which lands with the prefill, minus
+    submit) and tokens/s; aggregate tokens/s over the busy window
+    (first admission to last token)."""
+
+    def __init__(self, clock=time.monotonic):
+        self.clock = clock
+        self.submitted = 0
+        self.admitted = 0
+        self.completed = 0
+        self.tokens_out = 0
+        self.rejected: Counter = Counter()  # reason -> n
+        self.outcomes: Counter = Counter()  # done/eos -> n
+        self.requests: Dict[str, _ReqRecord] = {}
+        self._steps = 0
+        self._active_slot_steps = 0
+        self._max_slots = 0
+        self._queue_depth = 0
+        self._active_now = 0
+        self._t_first_admit: Optional[float] = None
+        self._t_last_token: Optional[float] = None
+
+    # -- engine hooks -------------------------------------------------------
+
+    def on_submit(self, rid: str) -> None:
+        self.submitted += 1
+        self.requests[rid] = _ReqRecord(submit_s=self.clock())
+
+    def on_reject(self, rid: str, reason: str) -> None:
+        self.rejected[reason] += 1
+        rec = self.requests.setdefault(rid, _ReqRecord(submit_s=self.clock()))
+        rec.outcome = f"rejected:{reason}"
+
+    def on_admit(self, rid: str, prompt_len: int) -> None:
+        self.admitted += 1
+        rec = self.requests.setdefault(rid, _ReqRecord())
+        rec.admit_s = self.clock()
+        rec.prompt_len = prompt_len
+        if self._t_first_admit is None:
+            self._t_first_admit = rec.admit_s
+
+    def on_token(self, rid: str) -> None:
+        """One generated token (the first lands with the prefill)."""
+        now = self.clock()
+        rec = self.requests.setdefault(rid, _ReqRecord())
+        if rec.tokens == 0:
+            rec.first_token_s = now
+        rec.tokens += 1
+        self.tokens_out += 1
+        self._t_last_token = now
+
+    def on_finish(self, rid: str, outcome: str) -> None:
+        self.completed += 1
+        self.outcomes[outcome] += 1
+        rec = self.requests.setdefault(rid, _ReqRecord())
+        rec.outcome = outcome
+        rec.finish_s = self.clock()
+
+    def on_step(self, active_slots: int, max_slots: int, queue_depth: int):
+        """One engine iteration (decode step or idle-admit pass)."""
+        self._steps += 1
+        self._active_slot_steps += active_slots
+        self._max_slots = max(self._max_slots, max_slots)
+        self._active_now = active_slots
+        self._queue_depth = queue_depth
+
+    # -- views --------------------------------------------------------------
+
+    def request_stats(self, rid: str) -> Dict[str, float]:
+        rec = self.requests[rid]
+        ttft = (
+            rec.first_token_s - rec.submit_s if rec.first_token_s else 0.0
+        )
+        dur = (rec.finish_s or self.clock()) - (rec.admit_s or rec.submit_s)
+        return {
+            "ttft_s": ttft,
+            "tokens": rec.tokens,
+            "tokens_per_s": rec.tokens / dur if dur > 0 else 0.0,
+            "outcome": rec.outcome,
+        }
+
+    def snapshot(self) -> Dict[str, float]:
+        """Flat numeric record — what ``ServingSource`` samples into a
+        MonitorSample and the autoscaler would consume as serving
+        load."""
+        ttfts = [
+            r.first_token_s - r.submit_s
+            for r in self.requests.values()
+            if r.first_token_s
+        ]
+        busy = 0.0
+        if self._t_first_admit is not None and self._t_last_token is not None:
+            busy = self._t_last_token - self._t_first_admit
+        snap: Dict[str, float] = {
+            "submitted": float(self.submitted),
+            "admitted": float(self.admitted),
+            "rejected": float(sum(self.rejected.values())),
+            "completed": float(self.completed),
+            "tokens_out": float(self.tokens_out),
+            "queue_depth": float(self._queue_depth),
+            "active_slots": float(self._active_now),
+            "max_slots": float(self._max_slots),
+            "slot_occupancy": (
+                self._active_slot_steps / (self._steps * self._max_slots)
+                if self._steps and self._max_slots
+                else 0.0
+            ),
+            "ttft_avg_s": sum(ttfts) / len(ttfts) if ttfts else 0.0,
+            "ttft_max_s": max(ttfts) if ttfts else 0.0,
+            "agg_tokens_per_s": self.tokens_out / busy if busy > 0 else 0.0,
+        }
+        for reason, n in sorted(self.rejected.items()):
+            snap[f"rejected_{reason}"] = float(n)
+        return snap

@@ -1,0 +1,154 @@
+"""Continuous-batching soak: batched vs sequential serving throughput.
+
+The serving thesis in one experiment (BENCH_r05: decode is HBM-bound
+and batch-sensitive — 0.73 of roofline at B=1 vs 0.93 at B=32, so
+cross-request batching is the biggest unexploited throughput lever).
+A synthetic-arrival workload of mixed-length requests runs twice
+through the SAME engine runtime:
+
+  * continuous — ``max_slots`` KV slots, requests join/evict between
+    batched decode steps (the edl_tpu/serving engine proper);
+  * sequential — ``max_slots=1``: one request at a time, the
+    baseline every non-batching server is.
+
+Arrivals are step-indexed (request i joins the queue at engine
+iteration ``arrive[i]``), so mid-stream join/evict is genuinely
+exercised and the workload is reproducible; wall-clock only measures.
+Each config runs twice and reports the second pass (first pass pays
+the jit compiles; programs are memoized module-level, so pass 2 is
+pure serving). TTFT / occupancy / queue depth render through
+``monitor.collector.ServingSource`` — the same plumbing training load
+uses.
+
+CPU dryrun (default off-TPU): tiny config, 12 requests. On TPU the
+flagship decode config and a deeper workload run instead.
+
+    python scripts/exp_serving.py [--requests N] [--slots B]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+
+def build_workload(n_requests, vocab, rng, on_tpu):
+    """Mixed-length prompts/budgets + step-indexed arrivals."""
+    reqs = []
+    step = 0
+    for i in range(n_requests):
+        t0 = int(rng.randint(12, 96) if on_tpu else rng.randint(3, 14))
+        max_new = int(rng.randint(16, 48) if on_tpu else rng.randint(4, 12))
+        prompt = rng.randint(0, vocab, t0).tolist()
+        reqs.append(
+            {"rid": f"r{i}", "prompt": prompt, "max_new": max_new,
+             "arrive": step}
+        )
+        # bursty arrivals: some requests land together, some trickle
+        step += int(rng.randint(0, 4))
+    return reqs
+
+
+def run_workload(params, cfg, reqs, max_slots, max_len):
+    """Serve the workload; returns (elapsed_s, tokens, metrics)."""
+    from edl_tpu.serving.engine import ContinuousBatchingEngine
+    from edl_tpu.serving.metrics import ServingMetrics
+
+    metrics = ServingMetrics()
+    eng = ContinuousBatchingEngine(
+        params, cfg, max_slots=max_slots, max_len=max_len, metrics=metrics
+    )
+    pending = sorted(reqs, key=lambda r: r["arrive"])
+    t0 = time.perf_counter()
+    step = 0
+    i = 0
+    while i < len(pending) or eng.has_work:
+        while i < len(pending) and pending[i]["arrive"] <= step:
+            r = pending[i]
+            eng.submit(r["rid"], r["prompt"], r["max_new"])
+            i += 1
+        eng.step()
+        step += 1
+    elapsed = time.perf_counter() - t0
+    done = eng.results
+    tokens = sum(len(v.tokens) for v in done.values())
+    assert len(done) == len(reqs), (len(done), len(reqs))
+    return elapsed, tokens, metrics
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--requests", type=int, default=0, help="0 = auto")
+    ap.add_argument("--slots", type=int, default=0, help="0 = auto")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    from edl_tpu.models import llama
+    from edl_tpu.monitor.collector import Collector, ServingSource
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        from bench import flagship_decode_config
+
+        cfg = flagship_decode_config()
+        n_requests = args.requests or 24
+        slots = args.slots or 8
+        max_len = 256
+    else:  # CPU dryrun
+        cfg = llama.LlamaConfig.tiny(vocab=512)
+        n_requests = args.requests or 12
+        slots = args.slots or 4
+        max_len = 64
+
+    rng = np.random.RandomState(args.seed)
+    params = jax.jit(lambda: llama.init_params(jax.random.PRNGKey(1), cfg))()
+    if on_tpu:
+        import jax.numpy as jnp
+
+        params = jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.bfloat16), params
+        )
+    reqs = build_workload(n_requests, cfg.vocab, rng, on_tpu)
+    total_budget = sum(r["max_new"] for r in reqs)
+    print(
+        f"workload: {n_requests} requests, prompts "
+        f"{min(len(r['prompt']) for r in reqs)}-"
+        f"{max(len(r['prompt']) for r in reqs)} tokens, "
+        f"budgets {min(r['max_new'] for r in reqs)}-"
+        f"{max(r['max_new'] for r in reqs)} ({total_budget} total), "
+        f"platform={'tpu' if on_tpu else 'cpu-dryrun'}"
+    )
+
+    rows = []
+    for name, b in (("sequential", 1), ("continuous", slots)):
+        run_workload(params, cfg, reqs, b, max_len)  # pass 1: compiles
+        elapsed, tokens, metrics = run_workload(params, cfg, reqs, b, max_len)
+        snap = metrics.snapshot()
+        rows.append((name, b, elapsed, tokens, snap))
+        print(f"\n-- {name} (slots={b}): {tokens} tokens in {elapsed:.3f}s")
+        print(Collector(ServingSource(metrics)).poll().render())
+
+    (sname, _, st, stok, ssnap), (cname, cb, ct, ctok, csnap) = rows
+    seq_tps = stok / st
+    cont_tps = ctok / ct
+    print(f"\n{'config':<14} {'slots':>5} {'tokens':>7} {'wall_s':>8} "
+          f"{'tokens/s':>9} {'ttft_avg_s':>11} {'occupancy':>10}")
+    for name, b, elapsed, tokens, snap in rows:
+        print(
+            f"{name:<14} {b:>5} {tokens:>7} {elapsed:>8.3f} "
+            f"{tokens / elapsed:>9.1f} {snap['ttft_avg_s']:>11.4f} "
+            f"{snap['slot_occupancy']:>10.2%}"
+        )
+    print(
+        f"\ncontinuous-batching speedup: {cont_tps / seq_tps:.2f}x "
+        f"({cont_tps:.1f} vs {seq_tps:.1f} tokens/s)"
+    )
+
+
+if __name__ == "__main__":
+    main()
