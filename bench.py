@@ -644,6 +644,59 @@ def _llama_decode_bench() -> dict:
     return out
 
 
+def _llama_serving_bench() -> dict:
+    """Serving-engine rung: the continuous-batching engine end to end
+    (admission + fused horizon decode + donated-cache updates + the
+    double-buffered drain), not just the raw decode program the ladder
+    above times. Publishes aggregate tokens/s at horizon 1 vs 8 on a
+    fixed decode-heavy workload plus dispatches/token at H=8 — the
+    dispatch-amortization headline the fused loop exists for. Uses the
+    exp_serving harness functions so the bench and the soak script
+    cannot drift apart."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from edl_tpu.models import llama
+    from scripts.exp_serving import build_workload, run_workload
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        cfg = flagship_decode_config()
+        n_requests, slots, max_len = 12, 8, 256
+    else:
+        cfg = llama.LlamaConfig.tiny(vocab=512)
+        n_requests, slots, max_len = 6, 4, 96
+    params = jax.jit(lambda: llama.init_params(jax.random.PRNGKey(4), cfg))()
+    if on_tpu:
+        params = jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.bfloat16), params
+        )
+    reqs = build_workload(
+        n_requests, cfg.vocab, np.random.RandomState(7), on_tpu, deep=True
+    )
+    out: dict = {}
+    rate = {}
+    for h in (1, 8):
+        run_workload(params, cfg, reqs, slots, max_len, horizon=h)  # compile
+        elapsed, tokens, metrics = run_workload(
+            params, cfg, reqs, slots, max_len, horizon=h
+        )
+        snap = metrics.snapshot()
+        rate[h] = tokens / elapsed if elapsed > 0 else -1.0
+        out[f"serving_tokens_per_sec_h{h}"] = round(rate[h], 1)
+        out[f"serving_dispatches_per_token_h{h}"] = round(
+            snap["dispatches_per_token"], 4
+        )
+    out["serving_horizon_speedup"] = (
+        round(rate[8] / rate[1], 3) if rate[1] > 0 else -1.0
+    )
+    out["serving_config"] = f"slots{slots}/req{n_requests}"
+    del params
+    jax.clear_caches()
+    return out
+
+
 def main() -> None:
     n_dev = len(jax.devices())
     plan = MeshPlan.data_parallel(n_dev)
@@ -762,6 +815,7 @@ def main() -> None:
     # reshard-stall measurements above.
     llama_metrics = _llama_flagship_bench(n_dev, plan, mesh, rng)
     llama_metrics.update(_llama_decode_bench())
+    llama_metrics.update(_llama_serving_bench())
     llama_metrics.update(_p2p_bench())
 
     print(

@@ -15,6 +15,7 @@ SURVEY §5); built TPU-first:
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Any, Dict, Optional, Tuple
 
@@ -72,6 +73,13 @@ class LlamaConfig:
     # lm_head stay full precision; training-only (never rides
     # to_meta — exports are dense, serving unaffected).
     int8_mxu: bool = False
+    # with int8_mxu: keep wgrad (a^T @ g) on the bf16 MXU path while
+    # fwd/dgrad stay int8 (ADVICE r6) — gradients are heavy-tailed and
+    # wgrad contracts the batch·seq axis, so one outlier crushes a
+    # whole slice's absmax resolution; this caps long-run update noise
+    # at bf16 rounding for ~1/6 of the 2x rate win. Training-only,
+    # ignored without int8_mxu, never rides to_meta.
+    int8_wgrad_bf16: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -285,7 +293,9 @@ def attention(
 _INT8_WEIGHTS = ("wq", "wk", "wv", "wo", "w1", "w2", "w3")
 
 
-def _matw(a: jnp.ndarray, p, int8_mxu: bool = False) -> jnp.ndarray:
+def _matw(
+    a: jnp.ndarray, p, int8_mxu: bool = False, wgrad_bf16: bool = False
+) -> jnp.ndarray:
     """``a @ W`` where ``W`` is a plain weight array or a weight-only
     int8 record ``{"q8", "s8"}`` from :func:`quantize_params_int8`.
 
@@ -314,7 +324,7 @@ def _matw(a: jnp.ndarray, p, int8_mxu: bool = False) -> jnp.ndarray:
         # no dtype cast: quantization reads the f32 MASTER weight (a
         # bf16 pre-cast would stack ~2^-9 truncation under the int8
         # noise and materialize a bf16 weight copy per step)
-        return int8_matmul(a, p)
+        return int8_matmul(a, p, wgrad_bf16=wgrad_bf16)
     return a @ p.astype(dt)
 
 
@@ -350,10 +360,10 @@ def _qkv(cfg: LlamaConfig, a: jnp.ndarray, lp: Dict, positions=None):
     KV-cache decode so the model math cannot diverge between them."""
     b, t, _ = a.shape
     h, kv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    i8 = cfg.int8_mxu
-    q = _matw(a, lp["wq"], i8).reshape(b, t, h, hd)
-    k = _matw(a, lp["wk"], i8).reshape(b, t, kv, hd)
-    v = _matw(a, lp["wv"], i8).reshape(b, t, kv, hd)
+    i8, wb = cfg.int8_mxu, cfg.int8_wgrad_bf16
+    q = _matw(a, lp["wq"], i8, wb).reshape(b, t, h, hd)
+    k = _matw(a, lp["wk"], i8, wb).reshape(b, t, kv, hd)
+    v = _matw(a, lp["wv"], i8, wb).reshape(b, t, kv, hd)
     q = _rope(q, cfg.rope_theta, positions)
     k = _rope(k, cfg.rope_theta, positions)
     return q, k, v
@@ -362,11 +372,11 @@ def _qkv(cfg: LlamaConfig, a: jnp.ndarray, lp: Dict, positions=None):
 def _mlp(cfg: LlamaConfig, x: jnp.ndarray, lp: Dict) -> jnp.ndarray:
     """Post-attention SwiGLU block (residual included) — shared by the
     training layer and the decode step."""
-    i8 = cfg.int8_mxu
+    i8, wb = cfg.int8_mxu, cfg.int8_wgrad_bf16
     m = _rmsnorm(x, lp["ln2"], cfg.norm_eps)
-    gate = checkpoint_name(jax.nn.silu(_matw(m, lp["w1"], i8)), "mlp_gate")
-    up = checkpoint_name(_matw(m, lp["w3"], i8), "mlp_up")
-    return x + _matw(gate * up, lp["w2"], i8)
+    gate = checkpoint_name(jax.nn.silu(_matw(m, lp["w1"], i8, wb)), "mlp_gate")
+    up = checkpoint_name(_matw(m, lp["w3"], i8, wb), "mlp_up")
+    return x + _matw(gate * up, lp["w2"], i8, wb)
 
 
 def _layer(
@@ -385,7 +395,7 @@ def _layer(
     a = _rmsnorm(x, lp["ln1"], cfg.norm_eps)
     q, k, v = _qkv(cfg, a, lp)
     o = attention(q, k, v, cfg, mesh=mesh, sp=sp).reshape(b, t, -1)
-    x = x + _matw(o, lp["wo"], cfg.int8_mxu)
+    x = x + _matw(o, lp["wo"], cfg.int8_mxu, cfg.int8_wgrad_bf16)
     out = _mlp(cfg, x, lp)
     return (out, k, v) if with_kv else out
 
@@ -676,6 +686,77 @@ def decode_step_slots(
     return logits, kc, vc
 
 
+def decode_horizon_slots(
+    params: Dict,
+    tok: jnp.ndarray,
+    pos: jnp.ndarray,
+    active: jnp.ndarray,
+    rem: jnp.ndarray,
+    eosv: jnp.ndarray,
+    kc: jnp.ndarray,
+    vc: jnp.ndarray,
+    cfg: LlamaConfig,
+    horizon: int,
+    key: Optional[jax.Array] = None,
+    temperature=None,
+    sampling: bool = False,
+):
+    """A fused HORIZON of ``horizon`` slot-decode steps in one program —
+    ``lax.scan`` over :func:`decode_step_slots` with per-slot
+    termination handled ON DEVICE, so the serving engine pays one
+    dispatch (and one host sync, deferrable) per H tokens per slot
+    instead of one per token.
+
+    Per-slot device state (all [B], the scan carry alongside the KV
+    cache): ``tok`` the previous token, ``pos`` the cache position the
+    next step writes, ``active`` whether the slot is still decoding,
+    ``rem`` tokens the slot may still emit, ``eosv`` its stop token
+    (-1 = none; read-only here — only admission changes it). Each step
+    every row runs the SAME batched math (the program never changes
+    shape); a row that emits its ``eosv`` token or exhausts ``rem``
+    FREEZES: tok/pos/rem stop advancing and its output lanes read -1.
+    A frozen row keeps re-running the identical step — its cache
+    rewrite at the frozen ``pos`` is idempotent (same token, same
+    position, same visible cache ⇒ bit-identical K/V) and strictly
+    row-local, so active rows decode exactly as if the frozen row had
+    been evicted. Greedy output is therefore token-identical to
+    stepping :func:`decode_step_slots` one position at a time, which
+    is itself per-row identical to sequential :func:`generate` — the
+    contract ``tests/test_serving.py`` pins at H ∈ {1, 4, 16}.
+
+    Returns ``(toks [B, horizon], tok, pos, active, rem, kc, vc)`` —
+    ``toks`` rows are emitted tokens with -1 in frozen lanes, and the
+    non-cache carries come back as device arrays so the engine can
+    dispatch the NEXT block without ever syncing them to the host (the
+    double-buffered pipeline in ``serving/engine.py``).
+
+    ``sampling`` (static) draws from ``logits / temperature`` with a
+    per-step key split from ``key``; greedy ignores both."""
+
+    def step(carry, k):
+        tok, pos, active, rem, kc, vc = carry
+        logits, kc, vc = decode_step_slots(params, tok, pos, kc, vc, cfg)
+        if sampling:
+            nxt = jax.random.categorical(k, logits / temperature, axis=-1)
+        else:
+            nxt = jnp.argmax(logits, axis=-1)
+        nxt = jnp.where(active, nxt.astype(jnp.int32), tok)
+        out = jnp.where(active, nxt, -1)
+        pos = jnp.where(active, pos + 1, pos)
+        rem = jnp.where(active, rem - 1, rem)
+        hit = active & (eosv >= 0) & (nxt == eosv)
+        active = active & ~hit & (rem > 0)
+        return (nxt, pos, active, rem, kc, vc), out
+
+    keys = jax.random.split(
+        key if key is not None else jax.random.PRNGKey(0), horizon
+    )
+    (tok, pos, active, rem, kc, vc), outs = jax.lax.scan(
+        step, (tok, pos, active, rem, kc, vc), keys
+    )
+    return jnp.swapaxes(outs, 0, 1), tok, pos, active, rem, kc, vc
+
+
 def generate(
     params: Dict,
     tokens: jnp.ndarray,
@@ -723,7 +804,7 @@ def generate(
         # path. Serving quantization is quantize_params_int8 instead.
         import dataclasses
 
-        cfg = dataclasses.replace(cfg, int8_mxu=False)
+        cfg = dataclasses.replace(cfg, int8_mxu=False, int8_wgrad_bf16=False)
     b, t0 = tokens.shape
     run = _generate_program(
         cfg, b, t0, int(max_new), temperature > 0, int(top_k), top_p < 1.0
@@ -737,7 +818,8 @@ def generate(
     )
 
 
-_generate_programs: Dict = {}
+_generate_programs: "OrderedDict" = OrderedDict()
+_GENERATE_PROGRAM_CAP = 64
 
 
 def _generate_program(cfg: LlamaConfig, b: int, t0: int, max_new: int,
@@ -747,10 +829,16 @@ def _generate_program(cfg: LlamaConfig, b: int, t0: int, max_new: int,
     prefill+decode scan instead of re-tracing (a full-size model pays
     minutes per compile). Temperature and the nucleus threshold are
     TRACED scalars: sweeping them costs zero recompiles; only the
-    top_k VALUE is static (it sets the truncated shape)."""
+    top_k VALUE is static (it sets the truncated shape).
+
+    The cache is LRU (move-to-end on hit, evict-oldest at the cap):
+    the previous clear-everything eviction dropped the HOT serving
+    program the moment a 65th shape appeared, re-paying a full-size
+    compile mid-traffic."""
     cache_key = (cfg, b, t0, max_new, sampling, top_k, use_top_p)
     run = _generate_programs.get(cache_key)
     if run is not None:
+        _generate_programs.move_to_end(cache_key)
         return run
     kvh, hd, L = cfg.n_kv_heads, cfg.head_dim, cfg.n_layers
     max_len = t0 + max_new
@@ -795,8 +883,8 @@ def _generate_program(cfg: LlamaConfig, b: int, t0: int, max_new: int,
         )
         return jnp.swapaxes(toks, 0, 1)  # [B, max_new]
 
-    if len(_generate_programs) > 64:
-        _generate_programs.clear()
+    while len(_generate_programs) >= _GENERATE_PROGRAM_CAP:
+        _generate_programs.popitem(last=False)  # evict least-recent
     _generate_programs[cache_key] = run
     return run
 

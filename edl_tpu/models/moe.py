@@ -53,6 +53,10 @@ class MoEConfig:
     # LlamaConfig.int8_mxu: weights at rest stay dense, the flag never
     # rides the export record)
     int8_mxu: bool = False
+    # with int8_mxu: keep wgrad on the bf16 path (same contract as
+    # LlamaConfig.int8_wgrad_bf16 — the outlier-resolution escape
+    # hatch; training-only, never rides the export record)
+    int8_wgrad_bf16: bool = False
 
     def to_meta(self) -> dict:
         """JSON-safe architecture record for export manifests
@@ -61,6 +65,7 @@ class MoEConfig:
 
         meta = dataclass_meta(self, "moe")
         meta.pop("int8_mxu")  # training-only: never a load contract
+        meta.pop("int8_wgrad_bf16")
         return meta
 
     @classmethod
@@ -184,16 +189,16 @@ def _layer(cfg: MoEConfig, x: jnp.ndarray, lp: Dict):
     dt = x.dtype
     b, t, d = x.shape
     h, kv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    i8 = cfg.int8_mxu
+    i8, wb = cfg.int8_mxu, cfg.int8_wgrad_bf16
     # attention block — llama's, verbatim building blocks (_matw
     # routes through the int8 MXU path when the flag is set)
     a = _ll._rmsnorm(x, lp["ln1"], cfg.norm_eps)
-    q = _ll._matw(a, lp["wq"], i8).reshape(b, t, h, hd)
-    k = _ll._matw(a, lp["wk"], i8).reshape(b, t, kv, hd)
-    v = _ll._matw(a, lp["wv"], i8).reshape(b, t, kv, hd)
+    q = _ll._matw(a, lp["wq"], i8, wb).reshape(b, t, h, hd)
+    k = _ll._matw(a, lp["wk"], i8, wb).reshape(b, t, kv, hd)
+    v = _ll._matw(a, lp["wv"], i8, wb).reshape(b, t, kv, hd)
     q, k = _ll._rope(q, cfg.rope_theta), _ll._rope(k, cfg.rope_theta)
     o = _ll.attention(q, k, v, lcfg).reshape(b, t, h * hd)
-    x = x + _ll._matw(o, lp["wo"], i8)
+    x = x + _ll._matw(o, lp["wo"], i8, wb)
     # routed expert FFN
     m = _ll._rmsnorm(x, lp["ln2"], cfg.norm_eps)
     y, aux = moe_ffn(
@@ -206,6 +211,7 @@ def _layer(cfg: MoEConfig, x: jnp.ndarray, lp: Dict):
         k=cfg.top_k,
         capacity_factor=cfg.capacity_factor,
         int8_mxu=i8,
+        int8_wgrad_bf16=wb,
     )
     return x + y, aux
 

@@ -27,6 +27,8 @@ at rest. Wired into the flagship via ``LlamaConfig.int8_mxu``.
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -63,23 +65,35 @@ def _mm(a: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
     return y.astype(a.dtype).reshape(shape[:-1] + (w.shape[-1],))
 
 
-@jax.custom_vjp
-def int8_matmul(a: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+@partial(jax.custom_vjp, nondiff_argnums=(2,))
+def int8_matmul(
+    a: jnp.ndarray, w: jnp.ndarray, wgrad_bf16: bool = False
+) -> jnp.ndarray:
     """``a @ w`` on the int8 MXU path with STE gradients.
 
     a: [..., K] activations (any leading dims), w: [K, N] weights.
     Returns [..., N] in ``a.dtype``.
+
+    ``wgrad_bf16`` keeps the WEIGHT gradient (a^T @ g) on the bf16 MXU
+    path while the forward and dgrad stay int8 (ADVICE r6): gradient
+    tensors are heavy-tailed, and wgrad contracts over the batch·seq
+    axis — one outlier element crushes the absmax resolution of an
+    entire M-slice for BOTH operands, and the resulting weight-update
+    noise compounds over a long run in a way the 30-step loss parity
+    never sees. wgrad is 1 of the 3 training matmuls, so the knob
+    trades at most ~1/6 of the 2x rate win for an update path whose
+    error is bf16 rounding, not quantization.
     """
     return _mm(a, w)
 
 
-def _fwd(a, w):
+def _fwd(a, w, wgrad_bf16):
     # residuals are the raw operands — exactly what plain autodiff of
     # a dense matmul would save, so remat policies see nothing new
     return _mm(a, w), (a, w)
 
 
-def _bwd(res, g):
+def _bwd(wgrad_bf16, res, g):
     a, w = res
     k = a.shape[-1]
     a2 = a.reshape(-1, k)
@@ -89,24 +103,39 @@ def _bwd(res, g):
     qwn, swn = absmax_quant(w, 1)  # [K, 1] per weight ROW this time
     da = _dot8(qg, qwn, ((1,), (1,))).astype(jnp.float32) * (sg * swn.T)
     # wgrad dw = a^T @ g contracts M: fresh scales along M for both
-    qam, sam = absmax_quant(a2, 0)  # [1, K]
-    qgm, sgm = absmax_quant(g2, 0)  # [1, N]
-    dw = _dot8(qam, qgm, ((0,), (0,))).astype(jnp.float32) * (sam.T * sgm)
+    if wgrad_bf16:
+        # bf16 operands, f32 accumulation — the MXU's native full-rate
+        # path, no quantization of the outlier-heavy gradient
+        dw = lax.dot_general(
+            a2.astype(jnp.bfloat16),
+            g2.astype(jnp.bfloat16),
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+    else:
+        qam, sam = absmax_quant(a2, 0)  # [1, K]
+        qgm, sgm = absmax_quant(g2, 0)  # [1, N]
+        dw = _dot8(qam, qgm, ((0,), (0,))).astype(jnp.float32) * (
+            sam.T * sgm
+        )
     return da.astype(a.dtype).reshape(a.shape), dw.astype(w.dtype)
 
 
 int8_matmul.defvjp(_fwd, _bwd)
 
 
-def int8_batched_matmul(a: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+def int8_batched_matmul(
+    a: jnp.ndarray, w: jnp.ndarray, wgrad_bf16: bool = False
+) -> jnp.ndarray:
     """Batched ``a @ w`` on the int8 MXU path with STE gradients — the
     expert-parallel twin of :func:`int8_matmul` (MoE expert FFNs are
     [E, C, K] x [E, K, N] batched matmuls; `parallel/moe.py`).
 
     Just a vmap of the 2D op: per expert slice that IS the identical
     recipe (per-row/per-column absmax along each dot's contraction
-    axis, fresh scales for dgrad/wgrad), and a hand-written batched
-    twin would be a second quantizer copy to drift — XLA lowers the
-    vmapped dots to the same batched int8 dot_general.
+    axis, fresh scales for dgrad/wgrad — and the same ``wgrad_bf16``
+    escape hatch), and a hand-written batched twin would be a second
+    quantizer copy to drift — XLA lowers the vmapped dots to the same
+    batched int8 dot_general.
     """
-    return jax.vmap(int8_matmul)(a, w)
+    return jax.vmap(partial(int8_matmul, wgrad_bf16=wgrad_bf16))(a, w)

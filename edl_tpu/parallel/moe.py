@@ -49,6 +49,7 @@ def moe_ffn(
     k: int = 2,
     capacity_factor: float = 1.25,
     int8_mxu: bool = False,
+    int8_wgrad_bf16: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Top-k routed expert FFN. x [B, T, D] → (y [B, T, D], aux_loss).
 
@@ -59,6 +60,9 @@ def moe_ffn(
     double-rate int8 path (ops/int8_matmul.int8_batched_matmul) —
     the routing/dispatch einsums stay full precision (they are
     bandwidth-shaped one-hot contractions, not FLOPs).
+    ``int8_wgrad_bf16`` keeps their wgrad on the bf16 path (the
+    outlier-resolution escape hatch, same contract as
+    ``LlamaConfig.int8_wgrad_bf16``).
     """
     b, t, d = x.shape
     n_tokens = b * t
@@ -103,8 +107,14 @@ def moe_ffn(
     if int8_mxu:
         from edl_tpu.ops.int8_matmul import int8_batched_matmul
 
-        h = jax.nn.relu(int8_batched_matmul(expert_in, params["w_in"]))
-        expert_out = int8_batched_matmul(h, params["w_out"])
+        h = jax.nn.relu(
+            int8_batched_matmul(
+                expert_in, params["w_in"], wgrad_bf16=int8_wgrad_bf16
+            )
+        )
+        expert_out = int8_batched_matmul(
+            h, params["w_out"], wgrad_bf16=int8_wgrad_bf16
+        )
     else:
         h = jax.nn.relu(
             jnp.einsum("ecd,edf->ecf", expert_in, params["w_in"])

@@ -96,6 +96,13 @@ class WorkerConfig:
     # training"). Exports and checkpoints are unaffected: weights at
     # rest stay dense.
     int8_mxu: bool = False
+    # with int8_mxu: keep wgrad on the bf16 MXU path while fwd/dgrad
+    # stay int8 (ADVICE r6 — gradients are heavy-tailed; one outlier
+    # crushes a whole contraction slice's absmax resolution, and the
+    # weight-update noise compounds over runs far longer than the
+    # measured loss-parity window). ~1/6 of the 2x rate win for an
+    # update path whose error is bf16 rounding, not quantization.
+    int8_wgrad_bf16: bool = False
     # TPU slice this host belongs to (multi-slice topology). -1 =
     # unknown: the mesh build falls back to the hardware's own
     # ``device.slice_index`` (real multislice TPU exposes it). When set
@@ -149,6 +156,7 @@ class WorkerConfig:
             eval_max_rows=int(e.get("EDL_EVAL_MAX_ROWS", "4096")),
             eval_device=e.get("EDL_EVAL_DEVICE", ""),
             int8_mxu=e.get("EDL_INT8_MXU", "0") == "1",
+            int8_wgrad_bf16=e.get("EDL_INT8_WGRAD_BF16", "0") == "1",
             # MEGASCALE_SLICE_ID is what GKE injects into multislice
             # TPU pods — honoring it makes the kube path slice-aware
             # with no manifest change

@@ -152,7 +152,9 @@ def _llama_workload(cfg: WorkerConfig) -> Workload:
     from edl_tpu.models import llama
 
     mcfg = dataclasses.replace(
-        llama.LlamaConfig.tiny(vocab=cfg.vocab), int8_mxu=cfg.int8_mxu
+        llama.LlamaConfig.tiny(vocab=cfg.vocab),
+        int8_mxu=cfg.int8_mxu,
+        int8_wgrad_bf16=cfg.int8_wgrad_bf16,
     )
 
     def batch_fn(start: int, end: int) -> Dict[str, np.ndarray]:
@@ -247,7 +249,9 @@ def _moe_workload(cfg: WorkerConfig) -> Workload:
     from edl_tpu.models import moe
 
     mcfg = dataclasses.replace(
-        moe.MoEConfig.tiny(vocab=cfg.vocab), int8_mxu=cfg.int8_mxu
+        moe.MoEConfig.tiny(vocab=cfg.vocab),
+        int8_mxu=cfg.int8_mxu,
+        int8_wgrad_bf16=cfg.int8_wgrad_bf16,
     )
 
     def batch_fn(start: int, end: int) -> Dict[str, np.ndarray]:

@@ -1,42 +1,75 @@
 """Continuous-batching generation engine — a slot-table decode loop
-over the llama KV-cache path.
+over the llama KV-cache path, driven in fused multi-step HORIZON
+blocks with a double-buffered async host pipeline.
 
 The decode roofline is HBM-bound and batch-sensitive (BENCH_r05: 0.73
 of roofline at B=1 vs 0.93 at B=32): a one-request-at-a-time server
 streams the full weight set per token for ONE token. This engine keeps
 a fixed table of ``max_slots`` KV slots and decodes every active slot
 in one batched step, prefill-inserting new requests into free slots and
-evicting finished ones BETWEEN steps — requests are the elastic
+evicting finished ones BETWEEN blocks — requests are the elastic
 membership, and the decode program never changes shape while they come
 and go.
 
-jit stability across membership changes is the design center, mirroring
-``llama._generate_program``:
+Three per-token costs the PR-1 engine paid are gone:
 
-* ONE compiled decode program per (cfg, max_slots, max_len, sampling) —
-  ``llama.decode_step_slots`` with per-row positions/masks, so a join
-  or evict changes host-side bookkeeping only, never the program;
+* **one dispatch per token** → one dispatch per ``horizon`` tokens:
+  ``llama.decode_horizon_slots`` scans H decode steps inside one
+  program, with per-slot termination (EOS / budget) handled on device
+  so finished rows freeze inside the block and greedy output stays
+  token-identical to sequential ``generate``;
+* **a blocking ``np.asarray`` per token** → a double-buffered pipeline:
+  the non-cache carries (tok/pos/active/rem) come back as DEVICE
+  arrays, so block k+1 dispatches before the host ever syncs block k's
+  token matrix; bookkeeping drains the previous block while the device
+  runs the next;
+* **a fresh full KV cache allocation + copy per step** → buffer
+  donation: both the fused-decode and prefill programs take ``kc``/
+  ``vc`` with ``donate_argnums``, so XLA updates the cache in place.
+  The engine enforces the stale-reference invariant itself
+  (:meth:`ContinuousBatchingEngine._assert_donated`): a donated buffer
+  that survives a dispatch means the in-place update silently
+  regressed to a copy.
+
+jit stability across membership changes is still the design center,
+mirroring ``llama._generate_program``:
+
+* ONE compiled block program per (cfg, max_slots, max_len, horizon,
+  sampling) — per-row positions/masks, so a join or evict changes
+  host-side bookkeeping only, never the program;
 * O(log max_prompt) compiled prefill programs — prompts pad into
   power-of-two buckets and ``llama.prefill_padded`` takes the real
   length as a traced scalar (causality makes end-padding invisible);
-  the prefill program also scatters the new K/V into the slot row and
-  samples the first token, so admission is one dispatch;
-* programs are memoized at module level (like ``_generate_programs``),
-  so engines are cheap to construct and tests/harnesses reuse compiles.
+  the prefill program also scatters the new K/V into the slot row,
+  samples the first token, and resets the slot's device-side decode
+  state, so admission is one dispatch;
+* programs are memoized module-level in an LRU (move-to-end on hit,
+  evict-oldest at the cap — a cache-clear here used to drop the hot
+  decode program mid-traffic), so engines are cheap to construct and
+  tests/harnesses reuse compiles.
+
+Admission lands on BLOCK boundaries (``InterleavePolicy.block_budget``
+— the drain-to-admit budget): when the queue is non-empty but no slot
+is known-free, the engine drains in-flight blocks first so a freed
+slot admits now rather than a block later. That drain is the one place
+serving latency is traded for admission latency; with free slots in
+view, admission never blocks the pipeline.
 
 Greedy decode (temperature == 0, the default) is token-identical to
-sequential ``llama.generate`` per request — the correctness contract
-``tests/test_serving.py`` pins, including mid-stream join/evict.
-Temperature sampling is supported but uses the engine's own per-step
-key schedule (a batched server cannot replay ``generate``'s per-request
-key walk).
+sequential ``llama.generate`` per request at EVERY horizon — the
+correctness contract ``tests/test_serving.py`` pins, including EOS
+hit mid-block and mid-stream join/evict. Temperature sampling is
+supported but uses the engine's own per-block key schedule (a batched
+server cannot replay ``generate``'s per-request key walk).
 """
 
 from __future__ import annotations
 
 import time
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from functools import partial
+from typing import Any, Deque, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -54,58 +87,81 @@ from edl_tpu.utils.logging import kv_logger
 
 log = kv_logger("serving")
 
-_programs: Dict = {}
+_programs: "OrderedDict" = OrderedDict()
+_PROGRAM_CAP = 128
 
 
 def _memo(key, make):
+    """Module-level LRU program cache: hits move to the end, inserts
+    past the cap evict the LEAST-recently-used entry — never the whole
+    cache (the old clear-everything eviction dropped the hot decode
+    program the moment a 129th prefill bucket appeared)."""
     fn = _programs.get(key)
-    if fn is None:
-        if len(_programs) > 128:
-            _programs.clear()
-        fn = _programs[key] = make()
+    if fn is not None:
+        _programs.move_to_end(key)
+        return fn
+    while len(_programs) >= _PROGRAM_CAP:
+        _programs.popitem(last=False)
+    fn = _programs[key] = make()
     return fn
 
 
-def _decode_program(cfg: llama.LlamaConfig, b: int, s: int, sampling: bool):
-    """(params, tok [B], pos [B], kc, vc, key, temperature) ->
-    (next_tok [B], kc, vc). The single program every membership
-    composition runs."""
+def _block_program(
+    cfg: llama.LlamaConfig, b: int, s: int, horizon: int, sampling: bool
+):
+    """(params, tok, pos, active, rem, eosv, kc, vc, key, temperature)
+    -> (toks [B, H], tok, pos, active, rem, kc, vc). One fused horizon
+    of H decode steps — the single program every membership composition
+    runs. kc/vc AND the consumed slot-state vectors are donated: the
+    cache updates in place and the returned carries are the only live
+    references."""
 
     def make():
-        @jax.jit
-        def run(params, tok, pos, kc, vc, key, temperature):
-            logits, kc, vc = llama.decode_step_slots(
-                params, tok, pos, kc, vc, cfg
+        @partial(jax.jit, donate_argnums=(1, 2, 3, 4, 6, 7))
+        def run(params, tok, pos, active, rem, eosv, kc, vc, key, temperature):
+            return llama.decode_horizon_slots(
+                params, tok, pos, active, rem, eosv, kc, vc, cfg,
+                horizon=horizon, key=key, temperature=temperature,
+                sampling=sampling,
             )
-            if sampling:
-                nxt = jax.random.categorical(key, logits / temperature, axis=-1)
-            else:
-                nxt = jnp.argmax(logits, axis=-1)
-            return nxt.astype(jnp.int32), kc, vc
 
         return run
 
-    return _memo(("decode", cfg, b, s, sampling), make)
+    return _memo(("block", cfg, b, s, horizon, sampling), make)
 
 
 def _prefill_program(cfg: llama.LlamaConfig, tb: int, sampling: bool):
-    """(params, tokens [1, Tb], last, kc, vc, slot, key, temperature)
-    -> (first_tok [1], kc, vc): prefill one padded prompt, scatter its
-    K/V into cache row ``slot``, emit the first generated token — one
-    dispatch per admission. ``last`` and ``slot`` are traced, so one
-    program serves every (length, slot) inside the bucket."""
+    """(params, tokens [1, Tb], last, slot, max_new, eos, tok, pos,
+    active, rem, eosv, kc, vc, key, temperature) -> (first_tok, tok,
+    pos, active, rem, eosv, kc, vc): prefill one padded prompt, scatter
+    its K/V into cache row ``slot``, emit the first generated token,
+    and reset the slot's device-side decode state (position, budget,
+    stop token, active mask — EOS-on-first-token and max_new == 1
+    deactivate on device exactly like the host bookkeeping) — one
+    dispatch per admission. ``last``/``slot``/``max_new``/``eos`` are
+    traced, so one program serves every (length, slot, budget) inside
+    the bucket. kc/vc and the slot-state vectors are donated, same
+    contract as the block program."""
 
     def make():
-        @jax.jit
-        def run(params, tokens, last, kc, vc, slot, key, temperature):
+        @partial(jax.jit, donate_argnums=(6, 7, 8, 9, 10, 11, 12))
+        def run(params, tokens, last, slot, max_new, eos,
+                tok, pos, active, rem, eosv, kc, vc, key, temperature):
             logits, ks, vs = llama.prefill_padded(params, tokens, last, cfg)
             kc = jax.lax.dynamic_update_slice(kc, ks, (0, slot, 0, 0, 0))
             vc = jax.lax.dynamic_update_slice(vc, vs, (0, slot, 0, 0, 0))
             if sampling:
-                tok = jax.random.categorical(key, logits / temperature, axis=-1)
+                t0 = jax.random.categorical(key, logits / temperature, axis=-1)
             else:
-                tok = jnp.argmax(logits, axis=-1)
-            return tok.astype(jnp.int32), kc, vc
+                t0 = jnp.argmax(logits, axis=-1)
+            t0 = t0.astype(jnp.int32)[0]
+            tok = tok.at[slot].set(t0)
+            pos = pos.at[slot].set(last + 1)
+            hit = (eos >= 0) & (t0 == eos)
+            active = active.at[slot].set(~hit & (max_new > 1))
+            rem = rem.at[slot].set(jnp.maximum(max_new - 1, 0))
+            eosv = eosv.at[slot].set(eos)
+            return t0, tok, pos, active, rem, eosv, kc, vc
 
         return run
 
@@ -114,10 +170,11 @@ def _prefill_program(cfg: llama.LlamaConfig, tb: int, sampling: bool):
 
 @dataclass
 class _Slot:
-    """Host-side state of one occupied KV slot."""
+    """Host-side state of one occupied KV slot (the device holds the
+    authoritative decode state; this is the bookkeeping mirror that
+    drained token matrices replay into)."""
 
     rid: str
-    pos: int  # cache position the NEXT decode step writes
     max_new: int
     eos_id: Optional[int]
     generated: List[int] = field(default_factory=list)
@@ -137,12 +194,19 @@ class ContinuousBatchingEngine:
     tree (``load_export``), a sharded one (``load_export_sharded``), or
     the weight-only int8 records (``quantize_params_int8``). The KV
     cache is [L, max_slots, max_len, KV, hd] in ``cfg.dtype`` — sized
-    once, reused forever.
+    once, donated through every dispatch, updated in place.
 
-    Drive it with :meth:`submit` + :meth:`step` (one admit/decode
-    iteration — the soak harness interleaves arrivals here) or
-    :meth:`run` (drain everything). Completed requests land in
-    ``results`` and the metrics hooks fire along the way.
+    ``horizon`` is the fused block depth: one device dispatch runs H
+    decode steps with per-slot termination on device. H=1 reproduces
+    the classic per-token iteration exactly (TTFT-optimal); larger H
+    divides dispatch + host-sync overhead by H at the cost of admission
+    landing on block boundaries (a new request waits up to H-1 steps
+    longer mid-block). Greedy tokens are identical at every H.
+
+    Drive it with :meth:`submit` + :meth:`step` (one admit/dispatch/
+    drain block iteration — the soak harness interleaves arrivals
+    here) or :meth:`run` (drain everything). Completed requests land
+    in ``results`` and the metrics hooks fire along the way.
     """
 
     def __init__(
@@ -152,6 +216,7 @@ class ContinuousBatchingEngine:
         *,
         max_slots: int = 8,
         max_len: int = 256,
+        horizon: int = 1,
         queue: Optional[RequestQueue] = None,
         metrics: Optional[ServingMetrics] = None,
         policy: Optional[InterleavePolicy] = None,
@@ -162,12 +227,15 @@ class ContinuousBatchingEngine:
     ):
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
+        if horizon < 1:
+            raise ValueError(f"horizon must be >= 1, got {horizon}")
         if temperature < 0:
             raise ValueError(f"temperature must be >= 0, got {temperature}")
         self.params = params
         self.cfg = cfg
         self.max_slots = max_slots
         self.max_len = max_len
+        self.horizon = horizon
         self.queue = queue or RequestQueue(max_total_len=max_len, clock=clock)
         if self.queue.max_total_len > max_len:
             raise ValueError(
@@ -182,17 +250,36 @@ class ContinuousBatchingEngine:
         self._sampling = self.temperature > 0
         self._key = jax.random.PRNGKey(seed)
         self._slots: List[Optional[_Slot]] = [None] * max_slots
-        self._tok = np.zeros(max_slots, np.int32)
-        self._pos = np.zeros(max_slots, np.int32)
+        # device-side slot decode state: the block program's carry.
+        # The host NEVER syncs these on the hot path — it feeds the
+        # returned device arrays straight into the next dispatch and
+        # reconstructs its bookkeeping view from drained token
+        # matrices instead.
+        self._dtok = jnp.zeros(max_slots, jnp.int32)
+        self._dpos = jnp.zeros(max_slots, jnp.int32)
+        self._dact = jnp.zeros(max_slots, bool)
+        self._drem = jnp.zeros(max_slots, jnp.int32)
+        self._deos = jnp.full((max_slots,), -1, jnp.int32)
         L, kvh, hd = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
         shape = (L, max_slots, max_len, kvh, hd)
         self._kc = jnp.zeros(shape, cfg.dtype)
         self._vc = jnp.zeros(shape, cfg.dtype)
-        self._decode = _decode_program(cfg, max_slots, max_len, self._sampling)
+        # dispatched-but-undrained block token matrices (device arrays);
+        # depth <= 2 transiently inside step(), <= 1 between steps —
+        # the double buffer
+        self._inflight: Deque[jax.Array] = deque()
+        # None until the first dispatch reveals whether this backend
+        # honors donation (CPU/TPU do; a backend that copies instead
+        # just loses the in-place win, not correctness)
+        self._donates: Optional[bool] = None
+        self._decode = _block_program(
+            cfg, max_slots, max_len, horizon, self._sampling
+        )
         log.info(
             "engine ready",
             slots=max_slots,
             max_len=max_len,
+            horizon=horizon,
             cache_mb=round(2 * np.prod(shape) * np.dtype(cfg.dtype).itemsize
                            / 2**20, 1),
             sampling=self._sampling,
@@ -235,45 +322,43 @@ class ContinuousBatchingEngine:
 
     @property
     def active_slots(self) -> int:
+        """Occupied slots in the HOST view (drained bookkeeping; an
+        in-flight block may already have finished some on device)."""
         return sum(1 for s in self._slots if s is not None)
 
     @property
     def has_work(self) -> bool:
-        return self.active_slots > 0 or self.queue.depth > 0
+        return (
+            self.active_slots > 0
+            or self.queue.depth > 0
+            or bool(self._inflight)
+        )
 
     def step(self) -> int:
-        """One engine iteration: admit up to the interleave budget of
-        queued requests into free slots (prefill-insert), then run ONE
-        batched decode step over every active slot. Returns tokens
-        emitted this iteration (prefill first-tokens included)."""
-        emitted = self._admit()
-        active = [i for i, s in enumerate(self._slots) if s is not None]
-        self.metrics.on_step(len(active), self.max_slots, self.queue.depth)
-        if not active:
-            return emitted
-        tok, self._kc, self._vc = self._decode(
-            self.params,
-            jnp.asarray(self._tok),
-            jnp.asarray(self._pos),
-            self._kc,
-            self._vc,
-            self._next_key(),
-            jnp.float32(self.temperature if self._sampling else 1.0),
-        )
-        out = np.asarray(tok)
-        for i in active:
-            sl = self._slots[i]
-            t = int(out[i])
-            sl.generated.append(t)
-            sl.pos += 1
-            self._tok[i] = t
-            self._pos[i] = sl.pos
-            self.metrics.on_token(sl.rid)
-            emitted += 1
-            if sl.eos_id is not None and t == sl.eos_id:
-                self._finish(i, "eos")
-            elif len(sl.generated) >= sl.max_new:
-                self._finish(i, "done")
+        """One engine iteration: admit up to the block budget of queued
+        requests into free slots (prefill-insert), dispatch ONE fused
+        horizon block over every active slot, then drain the PREVIOUS
+        block's token matrix while the new one runs on device. Returns
+        tokens observed this iteration (prefill first-tokens included;
+        decode tokens surface at the drain of their block)."""
+        emitted = 0
+        if self.queue.depth > 0:
+            if self._inflight and not any(s is None for s in self._slots):
+                # drain-to-admit: no slot is known-free, but an
+                # in-flight block may have finished one — sync now so
+                # the freed slot admits this boundary, not next
+                emitted += self._drain_all()
+            emitted += self._admit()
+        active_n = self.active_slots
+        self.metrics.on_step(active_n, self.max_slots, self.queue.depth)
+        if active_n:
+            self._dispatch_block()
+            # double buffer: block k+1 is now on device; drain block k
+            # (bookkeeping overlaps the device work, no idle bubble)
+            while len(self._inflight) > 1:
+                emitted += self._drain_one()
+        else:
+            emitted += self._drain_all()
         return emitted
 
     def run(self, max_steps: Optional[int] = None) -> Dict[str, RequestResult]:
@@ -292,6 +377,85 @@ class ContinuousBatchingEngine:
         self._key, sub = jax.random.split(self._key)
         return sub
 
+    def _temp(self):
+        return jnp.float32(self.temperature if self._sampling else 1.0)
+
+    def _assert_donated(self, *old) -> None:
+        """The stale-buffer invariant behind ``donate_argnums``: after
+        a dispatch, every donated input reference must be DEAD — the
+        engine holds only the returned arrays. A live old buffer means
+        XLA fell back to copying (the per-step cache copy this engine
+        exists to eliminate), except on backends that never donate,
+        detected once and logged rather than failed."""
+        if self._donates is None:
+            self._donates = old[-1].is_deleted()
+            if not self._donates:
+                log.warn(
+                    "buffer donation inactive on this backend; "
+                    "the KV cache copies once per dispatch"
+                )
+        if not self._donates:
+            return
+        for a in old:
+            if not a.is_deleted():
+                raise AssertionError(
+                    "donated buffer still live after dispatch — the "
+                    "in-place cache update regressed to a copy "
+                    f"(shape {a.shape}, dtype {a.dtype})"
+                )
+
+    def _dispatch_block(self) -> None:
+        old = (self._dtok, self._dpos, self._dact, self._drem,
+               self._kc, self._vc)
+        (toks, self._dtok, self._dpos, self._dact, self._drem,
+         self._kc, self._vc) = self._decode(
+            self.params, old[0], old[1], old[2], old[3], self._deos,
+            old[4], old[5], self._next_key(), self._temp(),
+        )
+        self.metrics.on_dispatch("decode")
+        self._assert_donated(*old)
+        self._inflight.append(toks)
+
+    def _drain_one(self) -> int:
+        """Sync the OLDEST in-flight block's [B, H] token matrix and
+        replay it into the host bookkeeping: append per-slot tokens,
+        stamp per-block metrics, finish EOS/budget rows. Frozen lanes
+        read -1 and terminate the row's replay — the device freezes a
+        row at exactly the step the host would finish it, so the two
+        views never disagree."""
+        out = np.asarray(self._inflight.popleft())
+        emitted = 0
+        for i in range(self.max_slots):
+            sl = self._slots[i]
+            if sl is None:
+                continue  # freed by an earlier drain; lanes are -1
+            n = 0
+            outcome = None
+            for t in out[i]:
+                t = int(t)
+                if t < 0:
+                    break
+                sl.generated.append(t)
+                n += 1
+                if sl.eos_id is not None and t == sl.eos_id:
+                    outcome = "eos"
+                    break
+                if len(sl.generated) >= sl.max_new:
+                    outcome = "done"
+                    break
+            if n:
+                self.metrics.on_tokens(sl.rid, n)
+                emitted += n
+            if outcome:
+                self._finish(i, outcome)
+        return emitted
+
+    def _drain_all(self) -> int:
+        emitted = 0
+        while self._inflight:
+            emitted += self._drain_one()
+        return emitted
+
     def _bucket(self, n: int) -> int:
         b = self.min_bucket
         while b < n:
@@ -300,7 +464,9 @@ class ContinuousBatchingEngine:
 
     def _admit(self) -> int:
         free = [i for i, s in enumerate(self._slots) if s is None]
-        budget = self.policy.budget(len(free), self.queue.depth)
+        budget = self.policy.block_budget(
+            len(free), self.queue.depth, self.horizon
+        )
         emitted = 0
         for _ in range(budget):
             req = self.queue.pop()
@@ -312,25 +478,33 @@ class ContinuousBatchingEngine:
             toks = np.zeros((1, tb), np.int32)
             toks[0, :t0] = req.prompt
             prefill = _prefill_program(self.cfg, tb, self._sampling)
-            tok0, self._kc, self._vc = prefill(
+            old = (self._dtok, self._dpos, self._dact, self._drem,
+                   self._deos, self._kc, self._vc)
+            (tok0, self._dtok, self._dpos, self._dact, self._drem,
+             self._deos, self._kc, self._vc) = prefill(
                 self.params,
                 jnp.asarray(toks),
                 jnp.int32(t0 - 1),
-                self._kc,
-                self._vc,
                 jnp.int32(slot),
+                jnp.int32(req.max_new),
+                jnp.int32(-1 if req.eos_id is None else req.eos_id),
+                old[0], old[1], old[2], old[3], old[4], old[5], old[6],
                 self._next_key(),
-                jnp.float32(self.temperature if self._sampling else 1.0),
+                self._temp(),
             )
-            tok0 = int(np.asarray(tok0)[0])
+            self.metrics.on_dispatch("prefill")
+            self._assert_donated(*old)
+            # admission is a sync point by design: the first token IS
+            # the TTFT sample, so it must be observed now, not a block
+            # later (and any block dispatched before this admission
+            # completed on device as a dependency of the prefill)
+            tok0 = int(np.asarray(tok0))
             self.metrics.on_admit(req.rid, t0)
             sl = _Slot(
-                rid=req.rid, pos=t0, max_new=req.max_new,
+                rid=req.rid, max_new=req.max_new,
                 eos_id=req.eos_id, generated=[tok0],
             )
             self._slots[slot] = sl
-            self._tok[slot] = tok0
-            self._pos[slot] = t0
             self.metrics.on_token(req.rid)
             emitted += 1
             if sl.eos_id is not None and tok0 == sl.eos_id:
@@ -345,9 +519,8 @@ class ContinuousBatchingEngine:
             rid=sl.rid, tokens=list(sl.generated), outcome=outcome
         )
         self.metrics.on_finish(sl.rid, outcome)
-        # eviction is bookkeeping only: the freed cache row is dead
-        # weight until the next prefill-insert overwrites it, and the
-        # decode program never changes shape
+        # eviction is bookkeeping only: the device already froze the
+        # row (active mask), the freed cache row is dead weight until
+        # the next prefill-insert overwrites it, and the block program
+        # never changes shape
         self._slots[slot] = None
-        self._tok[slot] = 0
-        self._pos[slot] = 0

@@ -124,9 +124,29 @@ class InterleavePolicy:
     requests (decode stalls while a burst prefills); 1 is the classic
     continuous-batching choice (Orca-style iteration scheduling), higher
     values drain a deep queue faster at the cost of decode latency
-    jitter."""
+    jitter.
+
+    With a fused decode HORIZON (H steps per dispatched block),
+    admission lands on BLOCK boundaries — there is no between-steps
+    gap inside a block to prefill into. :meth:`block_budget` is the
+    drain-to-admit budget for one boundary: the per-step rate scaled
+    by the H steps the block covers, so the admission rate a deployment
+    tuned at H=1 carries over unchanged to any horizon (a boundary
+    admits what H per-step boundaries would have)."""
 
     prefills_per_step: int = 1
 
     def budget(self, free_slots: int, queue_depth: int) -> int:
         return max(0, min(self.prefills_per_step, free_slots, queue_depth))
+
+    def block_budget(
+        self, free_slots: int, queue_depth: int, horizon: int
+    ) -> int:
+        return max(
+            0,
+            min(
+                self.prefills_per_step * max(1, horizon),
+                free_slots,
+                queue_depth,
+            ),
+        )

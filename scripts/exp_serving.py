@@ -1,4 +1,5 @@
-"""Continuous-batching soak: batched vs sequential serving throughput.
+"""Continuous-batching soak: batched vs sequential serving throughput,
+plus the fused-decode HORIZON sweep (dispatches per token).
 
 The serving thesis in one experiment (BENCH_r05: decode is HBM-bound
 and batch-sensitive — 0.73 of roofline at B=1 vs 0.93 at B=32, so
@@ -10,6 +11,13 @@ through the SAME engine runtime:
     batched decode steps (the edl_tpu/serving engine proper);
   * sequential — ``max_slots=1``: one request at a time, the
     baseline every non-batching server is.
+
+Then a decode-heavy workload sweeps ``--horizons``: the engine's fused
+block depth (one device dispatch = H decode steps, per-slot
+termination on device, donated KV buffers, double-buffered host
+drain). The sweep's headline is **dispatches per generated token** —
+the host/dispatch overhead the horizon exists to amortize; at H it
+should sit near 1/H plus the admission (prefill) overhead.
 
 Arrivals are step-indexed (request i joins the queue at engine
 iteration ``arrive[i]``), so mid-stream join/evict is genuinely
@@ -23,7 +31,14 @@ uses.
 CPU dryrun (default off-TPU): tiny config, 12 requests. On TPU the
 flagship decode config and a deeper workload run instead.
 
+``--dryrun`` is the CI smoke lane (scripts/run_tests.sh): horizon
+sweep only, tiny model, with HARD assertions that the fused loop has
+not regressed to per-token dispatch — decode dispatches must satisfy
+``dispatches/token <= 1/H + admission overhead`` (partial tail blocks
+counted), and H=8 must cut dispatches/token >= 4x vs H=1.
+
     python scripts/exp_serving.py [--requests N] [--slots B]
+        [--horizons 1,8] [--dryrun]
 """
 
 import argparse
@@ -37,13 +52,21 @@ import jax
 import numpy as np
 
 
-def build_workload(n_requests, vocab, rng, on_tpu):
-    """Mixed-length prompts/budgets + step-indexed arrivals."""
+def build_workload(n_requests, vocab, rng, on_tpu, deep=False):
+    """Mixed-length prompts/budgets + step-indexed arrivals. ``deep``
+    builds the decode-heavy variant for the horizon sweep (long
+    budgets, short prompts — dispatch amortization only shows when
+    blocks run full)."""
     reqs = []
     step = 0
     for i in range(n_requests):
-        t0 = int(rng.randint(12, 96) if on_tpu else rng.randint(3, 14))
-        max_new = int(rng.randint(16, 48) if on_tpu else rng.randint(4, 12))
+        if deep:
+            t0 = int(rng.randint(16, 64) if on_tpu else rng.randint(3, 8))
+            max_new = int(rng.randint(128, 192) if on_tpu
+                          else rng.randint(64, 80))
+        else:
+            t0 = int(rng.randint(12, 96) if on_tpu else rng.randint(3, 14))
+            max_new = int(rng.randint(16, 48) if on_tpu else rng.randint(4, 12))
         prompt = rng.randint(0, vocab, t0).tolist()
         reqs.append(
             {"rid": f"r{i}", "prompt": prompt, "max_new": max_new,
@@ -54,14 +77,15 @@ def build_workload(n_requests, vocab, rng, on_tpu):
     return reqs
 
 
-def run_workload(params, cfg, reqs, max_slots, max_len):
+def run_workload(params, cfg, reqs, max_slots, max_len, horizon=1):
     """Serve the workload; returns (elapsed_s, tokens, metrics)."""
     from edl_tpu.serving.engine import ContinuousBatchingEngine
     from edl_tpu.serving.metrics import ServingMetrics
 
     metrics = ServingMetrics()
     eng = ContinuousBatchingEngine(
-        params, cfg, max_slots=max_slots, max_len=max_len, metrics=metrics
+        params, cfg, max_slots=max_slots, max_len=max_len, horizon=horizon,
+        metrics=metrics,
     )
     pending = sorted(reqs, key=lambda r: r["arrive"])
     t0 = time.perf_counter()
@@ -81,18 +105,73 @@ def run_workload(params, cfg, reqs, max_slots, max_len):
     return elapsed, tokens, metrics
 
 
+def sweep_horizons(params, cfg, reqs, slots, max_len, horizons, check=False):
+    """Run the decode-heavy workload at each horizon; print the
+    dispatch-amortization table; with ``check``, assert the fused-loop
+    dispatch bounds (the CI smoke contract)."""
+    rows = []
+    print(f"\n{'horizon':>7} {'tokens':>7} {'wall_s':>8} {'tokens/s':>9} "
+          f"{'ttft_avg_s':>11} {'disp/tok':>9} {'decode':>7} {'prefill':>8}")
+    for h in horizons:
+        run_workload(params, cfg, reqs, slots, max_len, horizon=h)  # compiles
+        elapsed, tokens, metrics = run_workload(
+            params, cfg, reqs, slots, max_len, horizon=h
+        )
+        snap = metrics.snapshot()
+        rows.append((h, tokens, elapsed, snap))
+        print(
+            f"{h:>7} {tokens:>7} {elapsed:>8.3f} {tokens / elapsed:>9.1f} "
+            f"{snap['ttft_avg_s']:>11.4f} {snap['dispatches_per_token']:>9.3f} "
+            f"{snap['dispatches_decode']:>7.0f} "
+            f"{snap['dispatches_prefill']:>8.0f}"
+        )
+    if check:
+        for h, tokens, _, snap in rows:
+            # decode dispatches <= tokens/H + a partial block per
+            # admission (requests whose budget % H != 0 end mid-block)
+            # + a small pipeline tail — the bound that catches a
+            # silent regression to per-token dispatch
+            bound = tokens / h + 2 * snap["admitted"] + 4
+            assert snap["dispatches_decode"] <= bound, (
+                f"horizon {h}: {snap['dispatches_decode']:.0f} decode "
+                f"dispatches for {tokens} tokens exceeds the 1/H bound "
+                f"{bound:.0f} — the fused loop regressed toward "
+                f"per-token dispatch"
+            )
+        by_h = {h: snap["dispatches_per_token"] for h, _, _, snap in rows}
+        if 1 in by_h and 8 in by_h:
+            reduction = by_h[1] / by_h[8]
+            assert reduction >= 4.0, (
+                f"dispatches/token only fell {reduction:.2f}x from "
+                f"H=1 ({by_h[1]:.3f}) to H=8 ({by_h[8]:.3f}); need >= 4x"
+            )
+            print(f"\nhorizon 8 vs 1: {reduction:.2f}x fewer "
+                  f"dispatches/token (bounds OK)")
+    return rows
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--requests", type=int, default=0, help="0 = auto")
     ap.add_argument("--slots", type=int, default=0, help="0 = auto")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument(
+        "--horizons", default="1,8",
+        help="comma list of fused decode horizons to sweep",
+    )
+    ap.add_argument(
+        "--dryrun", action="store_true",
+        help="CI smoke lane: horizon sweep only, tiny model, hard "
+        "dispatch-bound assertions",
+    )
     args = ap.parse_args()
+    horizons = [int(h) for h in args.horizons.split(",") if h]
 
     from edl_tpu.models import llama
     from edl_tpu.monitor.collector import Collector, ServingSource
 
     on_tpu = jax.devices()[0].platform == "tpu"
-    if on_tpu:
+    if on_tpu and not args.dryrun:
         from bench import flagship_decode_config
 
         cfg = flagship_decode_config()
@@ -113,6 +192,18 @@ def main() -> None:
         params = jax.tree_util.tree_map(
             lambda x: x.astype(jnp.bfloat16), params
         )
+
+    if args.dryrun:
+        # smoke lane: assert the fused loop's dispatch bounds and exit.
+        # 8 decode-heavy requests keep it under ~a minute on CPU while
+        # leaving enough decode tokens for the 1/H signal to dominate
+        # the admission overhead.
+        deep = build_workload(8, cfg.vocab, rng, on_tpu, deep=True)
+        sweep_horizons(params, cfg, deep, slots, max(max_len, 96),
+                       sorted(set(horizons) | {1, 8}), check=True)
+        print("dryrun OK")
+        return
+
     reqs = build_workload(n_requests, cfg.vocab, rng, on_tpu)
     total_budget = sum(r["max_new"] for r in reqs)
     print(
@@ -148,6 +239,14 @@ def main() -> None:
         f"\ncontinuous-batching speedup: {cont_tps / seq_tps:.2f}x "
         f"({cont_tps:.1f} vs {seq_tps:.1f} tokens/s)"
     )
+
+    # the horizon sweep: decode-heavy workload, dispatch amortization
+    deep = build_workload(
+        max(8, n_requests // 2), cfg.vocab, rng, on_tpu, deep=True
+    )
+    # deep budgets need longer slots than the soak workload's off-TPU
+    sweep_horizons(params, cfg, deep, slots,
+                   max_len if on_tpu else max(max_len, 96), horizons)
 
 
 if __name__ == "__main__":

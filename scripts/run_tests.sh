@@ -38,6 +38,15 @@ python -m pytest tests/ -m slow -q "$@"
 rc3=$?
 t3=$(date +%s)
 echo "== phase 3 done in $((t3 - t2))s (rc=$rc3) =="
-echo "== total $((t3 - t0))s =="
 
-[ "$rc1" -eq 0 ] && [ "$rc2" -eq 0 ] && [ "$rc3" -eq 0 ]
+echo "== phase 4: serving dispatch-bound smoke (exp_serving --dryrun) =="
+# hard-asserts dispatches/token <= 1/H + admission overhead and the
+# >=4x H=8-vs-H=1 reduction, so the fused decode loop can't silently
+# regress to per-token dispatch
+JAX_PLATFORMS=cpu python scripts/exp_serving.py --dryrun
+rc4=$?
+t4=$(date +%s)
+echo "== phase 4 done in $((t4 - t3))s (rc=$rc4) =="
+echo "== total $((t4 - t0))s =="
+
+[ "$rc1" -eq 0 ] && [ "$rc2" -eq 0 ] && [ "$rc3" -eq 0 ] && [ "$rc4" -eq 0 ]

@@ -75,6 +75,70 @@ def test_gradients_track_exact():
     assert _rel_fro(np.asarray(dw_q), np.asarray(dw_d)) < 0.02
 
 
+def test_wgrad_bf16_knob():
+    """Satellite (ADVICE r6): ``wgrad_bf16=True`` keeps the weight
+    gradient on the bf16 path — dw matches the EXACT wgrad to bf16
+    rounding (far inside the int8 path's quantization band) while
+    dgrad and the forward stay on the int8 path (unchanged vs the
+    default)."""
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(7), 3)
+    a = jax.random.normal(k1, (64, 48), jnp.float32)
+    w = jax.random.normal(k2, (48, 40), jnp.float32)
+    ct = jax.random.normal(k3, (64, 40), jnp.float32)
+    # an outlier in the gradient: the exact failure mode the knob
+    # mitigates — one huge element crushes the whole M-slice's absmax
+    # resolution for the int8 wgrad, but not for the bf16 one
+    ct = ct.at[0, 0].set(500.0)
+
+    def loss(a, w, wb):
+        return (int8_matmul(a, w, wgrad_bf16=wb) * ct).sum()
+
+    da_b, dw_b = jax.grad(loss, (0, 1))(a, w, True)
+    da_q, dw_q = jax.grad(loss, (0, 1))(a, w, False)
+    da_d, dw_d = jax.grad(
+        lambda a, w: ((a @ w) * ct).sum(), (0, 1)
+    )(a, w)
+    # forward identical either way (same int8 path)
+    np.testing.assert_array_equal(
+        np.asarray(int8_matmul(a, w, wgrad_bf16=True)),
+        np.asarray(int8_matmul(a, w)),
+    )
+    # dgrad identical either way (still int8)
+    np.testing.assert_array_equal(np.asarray(da_b), np.asarray(da_q))
+    # bf16 wgrad is ~bf16-rounding-exact; int8 wgrad is visibly worse
+    # under the outlier
+    err_b = _rel_fro(np.asarray(dw_b), np.asarray(dw_d))
+    err_q = _rel_fro(np.asarray(dw_q), np.asarray(dw_d))
+    assert err_b < 0.005, err_b
+    assert err_b < err_q / 5, (err_b, err_q)
+
+
+def test_wgrad_bf16_plumbs_through_llama_config():
+    """LlamaConfig.int8_wgrad_bf16 reaches every projection matmul's
+    backward: gradients differ from the all-int8 run (the knob is
+    live) and stay finite; the forward is identical (fwd stays
+    int8)."""
+    import dataclasses
+
+    batch = jax.tree_util.tree_map(
+        jnp.asarray,
+        llama.synthetic_tokens(np.random.RandomState(0), 2, 16, 256),
+    )
+    base = dataclasses.replace(llama.LlamaConfig.tiny(), int8_mxu=True)
+    params = llama.init_params(jax.random.PRNGKey(0), base)
+    out = {}
+    for wb in (False, True):
+        cfg = dataclasses.replace(base, int8_wgrad_bf16=wb)
+        l, g = jax.value_and_grad(llama.make_loss_fn(cfg))(params, batch)
+        out[wb] = (float(l), g)
+    assert out[False][0] == out[True][0]  # forward path unchanged
+    gq = np.asarray(out[False][1]["layers"]["wq"])
+    gb = np.asarray(out[True][1]["layers"]["wq"])
+    assert np.isfinite(gb).all()
+    assert not np.array_equal(gq, gb)  # wgrad actually rerouted
+    assert _rel_fro(gb, gq) < 0.1  # ...but only by quantization noise
+
+
 def test_llama_int8_mxu_trains():
     """cfg.int8_mxu routes the seven projection matmuls through the
     quantized path; a tiny model must still train (loss falls) and its

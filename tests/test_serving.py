@@ -44,6 +44,145 @@ def _sequential(prompt, max_new, params=PARAMS, cfg=CFG):
 # -- engine correctness ------------------------------------------------------
 
 
+@pytest.mark.parametrize("horizon", [1, 4, 16])
+def test_horizon_greedy_token_identity(horizon):
+    """The fused-horizon acceptance contract: H decode steps per
+    dispatch (per-slot termination ON DEVICE) emit exactly sequential
+    ``generate``'s tokens — at H=1 (the classic per-token iteration),
+    H=4 and H=16, with budgets deliberately NOT divisible by H and
+    requests joining mid-stream so admission lands on block
+    boundaries while other slots are mid-block."""
+    prompts = [list(range(2, 2 + n)) for n in (4, 7, 3, 9, 5, 6)]
+    max_news = [6, 3, 13, 5, 7, 9]  # none divisible by 4 or 16
+    eng = ContinuousBatchingEngine(
+        PARAMS, CFG, max_slots=3, max_len=64, horizon=horizon
+    )
+    for i in range(3):
+        eng.submit(f"r{i}", prompts[i], max_news[i])
+    eng.step()  # first block in flight
+    for i in range(3, 6):  # join while a block is mid-pipeline
+        eng.submit(f"r{i}", prompts[i], max_news[i])
+    res = eng.run()
+    assert set(res) == {f"r{i}" for i in range(6)}
+    for i in range(6):
+        assert res[f"r{i}"].tokens == _sequential(prompts[i], max_news[i]), (
+            f"r{i} at horizon {horizon}"
+        )
+        assert res[f"r{i}"].outcome == "done"
+
+
+def test_horizon_eos_mid_block():
+    """EOS hit in the MIDDLE of a fused block freezes the row on
+    device: the EOS token is the last emitted (outcome "eos"), later
+    lanes of the block emit nothing, and slot-mates decode through the
+    same block unaffected."""
+    prompt = [5, 6, 7, 8]
+    full = _sequential(prompt, 8)
+    eos = full[2]  # 3rd token of an 8-budget request: mid-block at H=8
+    eng = ContinuousBatchingEngine(PARAMS, CFG, max_slots=2, max_len=64,
+                                   horizon=8)
+    eng.submit("stops", prompt, 8, eos_id=eos)
+    eng.submit("runs", [9, 10, 11], 6)
+    res = eng.run()
+    assert res["stops"].tokens == full[:3]
+    assert res["stops"].outcome == "eos"
+    assert res["runs"].tokens == _sequential([9, 10, 11], 6)
+    assert res["runs"].outcome == "done"
+
+
+def test_horizon_dispatch_amortization():
+    """The perf contract behind the fused loop: decode-heavy traffic
+    at H=8 runs >= 4x fewer device dispatches per generated token than
+    H=1 (the regression the exp_serving --dryrun CI lane also pins)."""
+    prompts = [[2, 3, 4], [5, 6], [7, 8, 9, 10]]
+    dpt = {}
+    for h in (1, 8):
+        eng = ContinuousBatchingEngine(
+            PARAMS, CFG, max_slots=3, max_len=64, horizon=h
+        )
+        for i, p in enumerate(prompts):
+            eng.submit(f"r{i}", p, 40 + i)  # deep budgets: decode-bound
+        eng.run()
+        snap = eng.metrics.snapshot()
+        assert snap["tokens_out"] == sum(40 + i for i in range(3))
+        dpt[h] = snap["dispatches_per_token"]
+        assert snap["dispatches_prefill"] == 3
+    assert dpt[1] / dpt[8] >= 4.0, dpt
+
+
+def test_donated_cache_second_use_raises():
+    """The stale-buffer invariant: every dispatch donates kc/vc (and
+    the slot-state vectors), so pre-dispatch references are DEAD — a
+    second use raises from jax, and the engine's own invariant saw the
+    buffers consumed (in-place update, no per-step cache copy)."""
+    eng = ContinuousBatchingEngine(PARAMS, CFG, max_slots=2, max_len=32,
+                                   horizon=4)
+    kc0, vc0 = eng._kc, eng._vc
+    ptr0 = kc0.unsafe_buffer_pointer()
+    eng.submit("a", [1, 2, 3], 6)
+    eng.step()  # prefill + first block both dispatched
+    assert eng._donates is True  # CPU/TPU backends donate
+    assert kc0.is_deleted() and vc0.is_deleted()
+    with pytest.raises(RuntimeError, match="deleted"):
+        np.asarray(kc0)
+    # buffer identity: the live cache occupies the ORIGINAL buffer's
+    # memory — the update chain is genuinely in place, no per-dispatch
+    # cache allocation + copy
+    assert eng._kc.unsafe_buffer_pointer() == ptr0
+    # the live handles still serve: the engine never touches the dead
+    # references, and the request completes token-identically
+    res = eng.run()
+    assert res["a"].tokens == _sequential([1, 2, 3], 6)
+
+
+def test_program_cache_lru_keeps_hot_entry():
+    """Satellite: the module-level program caches evict the OLDEST
+    entry at the cap instead of clearing everything (which dropped the
+    hot decode program mid-traffic)."""
+    from edl_tpu.serving import engine as eng_mod
+
+    # engine program cache: oldest evicted, touched entry survives
+    saved = eng_mod._programs.copy()
+    try:
+        eng_mod._programs.clear()
+        for i in range(eng_mod._PROGRAM_CAP):
+            eng_mod._memo(("fake", i), lambda: i)
+        eng_mod._memo(("fake", 0), lambda: "miss")  # touch: now MRU
+        eng_mod._memo(("fresh",), lambda: "new")  # evicts ("fake", 1)
+        assert ("fake", 0) in eng_mod._programs
+        assert ("fake", 1) not in eng_mod._programs
+        assert ("fresh",) in eng_mod._programs
+        assert len(eng_mod._programs) == eng_mod._PROGRAM_CAP
+    finally:
+        eng_mod._programs.clear()
+        eng_mod._programs.update(saved)
+
+    # llama generate cache: same policy
+    saved = llama._generate_programs.copy()
+    try:
+        llama._generate_programs.clear()
+        for i in range(llama._GENERATE_PROGRAM_CAP):
+            llama._generate_programs[("fake", i)] = i
+        llama.generate(
+            PARAMS, jnp.asarray([[1, 2]], jnp.int32), CFG, max_new=2
+        )
+        assert len(llama._generate_programs) == llama._GENERATE_PROGRAM_CAP
+        assert ("fake", 0) not in llama._generate_programs  # oldest out
+        assert ("fake", 1) in llama._generate_programs  # rest intact
+        real = [k for k in llama._generate_programs if k[0] != "fake"]
+        assert len(real) == 1
+        # a hit moves the real program to MRU — it survives the next
+        # eviction instead of being the oldest casualty of a clear
+        llama._generate_programs.move_to_end(real[0], last=False)
+        llama.generate(
+            PARAMS, jnp.asarray([[1, 2]], jnp.int32), CFG, max_new=2
+        )
+        assert next(reversed(llama._generate_programs)) == real[0]
+    finally:
+        llama._generate_programs.clear()
+        llama._generate_programs.update(saved)
+
+
 def test_batched_greedy_token_identical_with_midstream_join_evict():
     """The acceptance contract: a mixed-length prompt set served
     through 3 slots — with half the requests submitted only after
@@ -210,6 +349,20 @@ def test_interleave_policy_budget():
     assert InterleavePolicy().budget(4, 4) == 1
 
 
+def test_interleave_policy_block_budget():
+    """Admission lands on block boundaries under a fused horizon: one
+    boundary admits what H per-step boundaries would have, still
+    capped by free slots and queue depth."""
+    p = InterleavePolicy()
+    assert p.block_budget(free_slots=8, queue_depth=9, horizon=4) == 4
+    assert p.block_budget(free_slots=2, queue_depth=9, horizon=4) == 2
+    assert p.block_budget(free_slots=8, queue_depth=1, horizon=4) == 1
+    assert p.block_budget(free_slots=8, queue_depth=0, horizon=4) == 0
+    # H=1 degenerates to the per-step budget exactly
+    assert p.block_budget(4, 4, 1) == p.budget(4, 4) == 1
+    assert InterleavePolicy(prefills_per_step=2).block_budget(8, 9, 4) == 8
+
+
 # -- metrics + collector plumbing -------------------------------------------
 
 
@@ -236,6 +389,29 @@ def test_metrics_ttft_and_throughput_deterministic_clock():
     st = m.request_stats("a")
     assert st["ttft_s"] == pytest.approx(1.0)
     assert st["outcome"] == "done"
+
+
+def test_metrics_per_block_tokens_and_dispatches():
+    """Per-block accounting: on_tokens(rid, n) lands n tokens with one
+    clock read; dispatch counters feed dispatches_per_token; TTFT is
+    stamped by the admission-time on_token, NOT the block drain."""
+    t = [0.0]
+    m = ServingMetrics(clock=lambda: t[0])
+    m.on_submit("a")
+    t[0] = 1.0
+    m.on_admit("a", prompt_len=4)
+    m.on_dispatch("prefill")
+    m.on_token("a")  # first token with the prefill: TTFT = 1.0
+    t[0] = 9.0
+    m.on_dispatch("decode")
+    m.on_tokens("a", 8)  # one horizon-8 block drained at t=9
+    m.on_finish("a", "done")
+    snap = m.snapshot()
+    assert snap["ttft_avg_s"] == pytest.approx(1.0)  # not 9.0
+    assert snap["tokens_out"] == 9
+    assert snap["dispatches_decode"] == 1
+    assert snap["dispatches_prefill"] == 1
+    assert snap["dispatches_per_token"] == pytest.approx(2 / 9)
 
 
 def test_serving_source_through_collector():
