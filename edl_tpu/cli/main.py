@@ -10,7 +10,8 @@ training job" / "Check the job status"). One binary, subcommands:
     edl delete NAME --store DIR
     edl list --store DIR
     edl status NAME --store DIR
-    edl monitor --store DIR [--interval S]
+    edl monitor --store DIR [--interval S] [--json]
+    edl top ENDPOINT [--interval S]
     edl validate manifest.yaml
 
 The controller daemon and the other verbs meet at a JobStore spool
@@ -43,6 +44,26 @@ log = kv_logger("cli")
 # ---------------------------------------------------------------------------
 # controller daemon
 # ---------------------------------------------------------------------------
+
+
+def _start_fleet_exporter(args, cluster):
+    """Controller-side telemetry endpoint (``--metrics-port``): each
+    scrape of /metrics samples the live cluster through the SAME
+    collector plumbing `edl monitor` uses and re-exposes the census as
+    gauges (obs.fleet.registry_from_sample) — chip/CPU utilization,
+    per-job workers/parallelism/reshards/stall. Returns the exporter
+    or None."""
+    if getattr(args, "metrics_port", None) is None:
+        return None
+    from edl_tpu import obs
+    from edl_tpu.monitor.collector import ClusterSource
+
+    src = ClusterSource(cluster)
+    exp = obs.start_exporter(
+        lambda: obs.registry_from_sample(src.sample()), port=args.metrics_port
+    )
+    log.info("fleet metrics endpoint up", url=exp.url)
+    return exp
 
 
 def _slice_policy(args):
@@ -112,6 +133,7 @@ def run_controller_kube(args) -> int:
         ),
     )
     source = KubeJobSource(cluster, args.namespace)
+    exporter = _start_fleet_exporter(args, cluster)
     log.info(
         "controller started (kube mode)",
         api=api.base_url,
@@ -168,6 +190,8 @@ def run_controller_kube(args) -> int:
         if args.iterations is not None and i >= args.iterations:
             break
         time.sleep(args.tick_s)
+    if exporter is not None:
+        exporter.stop()
     return 0
 
 
@@ -201,6 +225,7 @@ def run_controller(args) -> int:
     )
     parser = JobParser()
     known = set()
+    exporter = _start_fleet_exporter(args, cluster)
 
     log.info(
         "controller started",
@@ -266,6 +291,8 @@ def run_controller(args) -> int:
         if args.iterations is not None and i >= args.iterations:
             break
         time.sleep(args.tick_s)
+    if exporter is not None:
+        exporter.stop()
     return 0
 
 
@@ -337,8 +364,32 @@ def run_monitor(args) -> int:
     from edl_tpu.monitor.collector import Collector, StoreSource
 
     store = JobStore(args.store)
-    Collector(StoreSource(store), interval_s=args.interval).run(n_polls=args.polls)
+    Collector(
+        StoreSource(store),
+        interval_s=args.interval,
+        jsonl=getattr(args, "json", False),
+    ).run(n_polls=args.polls)
     return 0
+
+
+def run_top(args) -> int:
+    """Live one-screen view of any edl telemetry endpoint (a serving
+    process's --metrics-port, a worker's EDL_METRICS_PORT, or the
+    coordinator's fleet aggregation) — scrape /metrics, summarize the
+    headline series, repeat."""
+    from edl_tpu.obs.top import top_once
+
+    i = 0
+    while True:
+        try:
+            print(top_once(args.endpoint, timeout_s=args.timeout), flush=True)
+        except OSError as e:
+            print(f"scrape failed for {args.endpoint}: {e}", file=sys.stderr)
+            return 1
+        i += 1
+        if args.polls is not None and i >= args.polls:
+            return 0
+        time.sleep(args.interval)
 
 
 def run_export_status(args) -> int:
@@ -684,6 +735,17 @@ def run_serve(args) -> int:
     )
     collector = Collector(ServingSource(metrics), out=sys.stderr)
 
+    exporter = None
+    if args.metrics_port is not None:
+        # the obs endpoint: /metrics (Prometheus text incl. the TTFT/
+        # ITL histograms this engine records), /trace (engine dispatch/
+        # drain spans), /healthz. 0 binds an ephemeral port.
+        from edl_tpu import obs
+
+        obs.bridge_tracer()
+        exporter = obs.start_exporter(port=args.metrics_port)
+        print(f"# metrics endpoint {exporter.url}/metrics", file=sys.stderr)
+
     rejected = {}
     for r in requests:
         try:
@@ -715,6 +777,8 @@ def run_serve(args) -> int:
             }
         print(json.dumps(rec))
     print(collector.poll().render(), file=sys.stderr)
+    if exporter is not None:
+        exporter.stop()
     return 0
 
 
@@ -873,6 +937,14 @@ def build_parser() -> argparse.ArgumentParser:
         "or auto (per job from spec.accelerator_type: catalog-capped "
         "pow2 with ICI-contiguous placement for TPU families)",
     )
+    c.add_argument(
+        "--metrics-port",
+        type=int,
+        default=None,
+        help="expose the fleet census as Prometheus text on this port "
+        "(0 = ephemeral): chip/CPU utilization, per-job workers/"
+        "reshards/stall — the scrapeable twin of `edl monitor`",
+    )
     c.set_defaults(fn=run_controller)
 
     s = sub.add_parser("submit", help="submit a TrainingJob manifest")
@@ -901,7 +973,31 @@ def build_parser() -> argparse.ArgumentParser:
     _add_store(m)
     m.add_argument("--interval", type=float, default=10.0)
     m.add_argument("--polls", type=int, default=None, help="stop after N polls")
+    m.add_argument(
+        "--json",
+        action="store_true",
+        help="emit one JSON object per poll (JSONL) instead of the "
+        "text table — the machine-readable twin scripts and the "
+        "autoscaler can tail",
+    )
     m.set_defaults(fn=run_monitor)
+
+    tp = sub.add_parser(
+        "top",
+        help="live one-screen view of an edl telemetry endpoint "
+        "(scrapes /metrics: TTFT percentiles, step-time breakdown, "
+        "reshard stalls, queue depth)",
+    )
+    tp.add_argument(
+        "endpoint",
+        help="host:port or URL of an exporter (`edl serve "
+        "--metrics-port`, a worker's EDL_METRICS_PORT, or the "
+        "coordinator's --metrics-port fleet aggregation)",
+    )
+    tp.add_argument("--interval", type=float, default=2.0)
+    tp.add_argument("--polls", type=int, default=None, help="stop after N polls")
+    tp.add_argument("--timeout", type=float, default=5.0)
+    tp.set_defaults(fn=run_top)
 
     v = sub.add_parser("validate", help="parse + validate a manifest")
     v.add_argument("manifest")
@@ -1030,6 +1126,13 @@ def build_parser() -> argparse.ArgumentParser:
         "--int8", action="store_true",
         help="weight-only int8 decode (per-output-column absmax "
         "records), as in `edl generate`",
+    )
+    sv.add_argument(
+        "--metrics-port", type=int, default=None,
+        help="expose /metrics (Prometheus: TTFT/ITL histograms, "
+        "dispatch counters, queue gauge), /trace (chrome-trace JSON), "
+        "/healthz on this port while serving (0 = ephemeral; the "
+        "bound URL prints on stderr)",
     )
     sv.set_defaults(fn=run_serve)
 

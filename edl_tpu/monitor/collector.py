@@ -16,6 +16,8 @@ Two sources:
 
 from __future__ import annotations
 
+import dataclasses
+import json
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -105,7 +107,7 @@ class MonitorSample:
 
     def _serving_lines(self) -> List[str]:
         s = self.serving
-        return [
+        lines = [
             "SERVING: "
             f"queue={s.get('queue_depth', 0):.0f} "
             f"active={s.get('active_slots', 0):.0f}"
@@ -120,6 +122,30 @@ class MonitorSample:
             f"completed={s.get('completed', 0):.0f} "
             f"tokens={s.get('tokens_out', 0):.0f}",
         ]
+        # histogram-backed latency percentiles (serving/metrics.py);
+        # absent on snapshots from engines predating them
+        if "ttft_p50_s" in s:
+            lines.append(
+                "  latency: ttft p50/p95/p99="
+                f"{s.get('ttft_p50_s', 0.0):.3f}/"
+                f"{s.get('ttft_p95_s', 0.0):.3f}/"
+                f"{s.get('ttft_p99_s', 0.0):.3f}s "
+                "itl p50/p95/p99="
+                f"{s.get('itl_p50_s', 0.0) * 1e3:.1f}/"
+                f"{s.get('itl_p95_s', 0.0) * 1e3:.1f}/"
+                f"{s.get('itl_p99_s', 0.0) * 1e3:.1f}ms"
+            )
+        return lines
+
+    def to_record(self) -> Dict:
+        """JSON-able machine-readable twin of :meth:`render` — what
+        ``edl monitor --json`` emits as JSONL for scripts and the
+        future autoscaler to tail. Field names match the dataclass,
+        plus the derived utilization percentages."""
+        rec = dataclasses.asdict(self)
+        rec["cpu_util"] = self.cpu_util
+        rec["chip_util"] = self.chip_util
+        return rec
 
 
 class ClusterSource:
@@ -200,12 +226,17 @@ class ServingSource:
 
 class Collector:
     """Poll a source and print samples (reference: Collector
-    collector.py:51 + the 10 s main loop :215-226)."""
+    collector.py:51 + the 10 s main loop :215-226). ``jsonl=True``
+    swaps the human table for one JSON object per poll
+    (:meth:`MonitorSample.to_record`) — the machine-readable twin."""
 
-    def __init__(self, source, interval_s: float = 10.0, out=None):
+    def __init__(
+        self, source, interval_s: float = 10.0, out=None, jsonl: bool = False
+    ):
         self.source = source
         self.interval_s = interval_s
         self.out = out
+        self.jsonl = jsonl
         self.samples: List[MonitorSample] = []
 
     def poll(self) -> MonitorSample:
@@ -220,8 +251,14 @@ class Collector:
         i = 0
         while n_polls is None or i < n_polls:
             s = self.poll()
-            print(time.strftime("---- %H:%M:%S", time.localtime(s.ts)), file=out)
-            print(s.render(), file=out, flush=True)
+            if self.jsonl:
+                print(json.dumps(s.to_record()), file=out, flush=True)
+            else:
+                print(
+                    time.strftime("---- %H:%M:%S", time.localtime(s.ts)),
+                    file=out,
+                )
+                print(s.render(), file=out, flush=True)
             i += 1
             if n_polls is not None and i >= n_polls:
                 break

@@ -1,0 +1,40 @@
+"""Unified telemetry: metrics registry + Prometheus/trace HTTP
+exporter + fleet push/aggregation.
+
+One coherent layer over what used to be three disconnected surfaces
+(tracing spans on the elastic path, serving averages, the monitor's
+human-only text table): every hot path records into a process-wide
+:class:`MetricsRegistry`, an HTTP exporter pull-exposes ``/metrics``
+(Prometheus text), ``/trace`` (chrome://tracing JSON), ``/healthz``,
+and workers push snapshots through the job coordinator KV for the
+fleet-aggregated view. See doc/observability.md for the metric
+catalog and endpoint reference.
+
+jax-free by construction — cli/monitor import this at module scope.
+"""
+
+from edl_tpu.obs.metrics import (  # noqa: F401
+    DEFAULT_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    default_registry,
+    ensure_core_series,
+    parse_prometheus_text,
+    percentile_from_buckets,
+    reset_default_registry,
+)
+from edl_tpu.obs.exporter import (  # noqa: F401
+    MetricsExporter,
+    scrape,
+    start_exporter,
+)
+from edl_tpu.obs.fleet import (  # noqa: F401
+    MetricsPusher,
+    aggregate_snapshots,
+    bridge_tracer,
+    collect_fleet,
+    metrics_key,
+    registry_from_sample,
+)

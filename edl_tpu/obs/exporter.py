@@ -1,0 +1,184 @@
+"""Telemetry HTTP exporter — stdlib ``http.server`` endpoint serving
+
+* ``/metrics``  — Prometheus text exposition (format 0.0.4) of a
+  :class:`~edl_tpu.obs.metrics.MetricsRegistry` (or of a callable that
+  rebuilds one per scrape — the coordinator's fleet aggregation mode);
+* ``/trace``    — the process tracer's chrome://tracing JSON (load in
+  Perfetto / chrome://tracing), with the ring-buffer ``dropped`` count
+  in the metadata;
+* ``/healthz``  — liveness JSON (status, uptime, pid).
+
+Pull-based on purpose (the Prometheus model): the process never blocks
+on a slow consumer, and a scraper outage costs nothing. The server is
+a daemon-threaded ``ThreadingHTTPServer`` bound by default to
+loopback; ``port=0`` binds an ephemeral port (tests, `--metrics-port
+0`). Scrapes read shared registries under their own family locks — a
+scrape never takes a lock the step loop holds across a dispatch.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional, Union
+
+from edl_tpu.obs.metrics import MetricsRegistry, ensure_core_series
+from edl_tpu.utils.logging import kv_logger
+
+log = kv_logger("obs")
+
+CONTENT_TYPE_METRICS = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class MetricsExporter:
+    """Own one telemetry endpoint.
+
+    ``source`` is a registry, or a zero-arg callable returning one
+    (re-evaluated per scrape; the fleet aggregator rebuilds a merged
+    registry from coordinator KV each time). ``tracer`` defaults to
+    the process-wide tracer so ``/trace`` always works.
+    """
+
+    def __init__(
+        self,
+        source: Union[MetricsRegistry, Callable[[], MetricsRegistry], None] = None,
+        *,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        tracer=None,
+    ):
+        if source is None:
+            from edl_tpu.obs.metrics import default_registry
+
+            source = default_registry()
+        self._collect: Callable[[], MetricsRegistry] = (
+            source if callable(source) else (lambda: source)
+        )
+        if isinstance(source, MetricsRegistry):
+            ensure_core_series(source)
+        if tracer is None:
+            from edl_tpu.utils import tracing
+
+            tracer = tracing.tracer()
+        self.tracer = tracer
+        self._host = host
+        self._want_port = port
+        self._t0 = time.monotonic()
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        if self._server is None:
+            raise RuntimeError("exporter not started")
+        return self._server.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://{self._host}:{self.port}"
+
+    def start(self) -> "MetricsExporter":
+        exporter = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            server_version = "edl-obs/1"
+
+            def do_GET(self):  # noqa: N802 (http.server API)
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path == "/metrics":
+                        body = exporter.render_metrics().encode()
+                        ctype = CONTENT_TYPE_METRICS
+                    elif path == "/trace":
+                        body = json.dumps(
+                            exporter.tracer.to_chrome_doc()
+                        ).encode()
+                        ctype = "application/json"
+                    elif path in ("/", "/healthz"):
+                        body = json.dumps(
+                            {
+                                "status": "ok",
+                                "uptime_s": round(
+                                    time.monotonic() - exporter._t0, 3
+                                ),
+                                "pid": os.getpid(),
+                                "endpoints": ["/metrics", "/trace", "/healthz"],
+                            }
+                        ).encode()
+                        ctype = "application/json"
+                    else:
+                        self.send_error(404, "unknown path")
+                        return
+                except Exception as e:  # collection failure, not transport
+                    body = f"collection failed: {e}\n".encode()
+                    self.send_response(500)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):  # silence per-scrape stderr
+                pass
+
+        srv = ThreadingHTTPServer((self._host, self._want_port), _Handler)
+        srv.daemon_threads = True
+        self._server = srv
+        self._thread = threading.Thread(
+            target=srv.serve_forever, name="edl-metrics-exporter", daemon=True
+        )
+        self._thread.start()
+        log.info("metrics exporter up", url=self.url)
+        return self
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def __enter__(self) -> "MetricsExporter":
+        return self.start() if self._server is None else self
+
+    def __exit__(self, *exc) -> bool:
+        self.stop()
+        return False
+
+    # -- collection ---------------------------------------------------------
+
+    def render_metrics(self) -> str:
+        return self._collect().render()
+
+
+def start_exporter(
+    source=None, *, port: int = 0, host: str = "127.0.0.1", tracer=None
+) -> MetricsExporter:
+    """Convenience: construct + start (``port=0`` = ephemeral)."""
+    return MetricsExporter(
+        source, port=port, host=host, tracer=tracer
+    ).start()
+
+
+def scrape(url: str, path: str = "/metrics", timeout_s: float = 5.0) -> str:
+    """GET one endpoint path and return the body text — the client
+    side used by ``edl top`` and the CI scrape lane. ``url`` may be a
+    bare ``host:port``."""
+    from urllib.request import urlopen
+
+    if not url.startswith("http"):
+        url = f"http://{url}"
+    with urlopen(url.rstrip("/") + path, timeout=timeout_s) as r:
+        return r.read().decode()

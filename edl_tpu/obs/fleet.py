@@ -1,0 +1,198 @@
+"""Fleet-wide telemetry plumbing — worker push, coordinator
+aggregation, tracer bridging, and monitor-sample exposition.
+
+The reference collector polls the cluster every 10 s and the
+autoscaler re-targets jobs from that census; here the equivalent data
+plane is: every worker pushes a JSON snapshot of its process-local
+:class:`~edl_tpu.obs.metrics.MetricsRegistry` into the job
+coordinator's KV (``{job}/metrics/{worker}``) on a fixed cadence, and
+the coordinator pod (runtime/coordinator_main.py ``--metrics-port``)
+re-exposes the union on ``/metrics`` with every series labeled by
+worker — one scrape shows the whole job.
+
+Push for the worker->coordinator hop (workers may be NAT'd pods a
+scraper can't reach; the KV plane already exists), pull for everything
+facing operators/autoscalers (Prometheus model). Snapshots are
+full-state, so a lost push costs staleness, never correctness, and
+aggregation rebuilds from scratch each scrape — no delta protocol.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Callable, Dict, Iterable, Optional
+
+from edl_tpu.obs.metrics import MetricsRegistry, default_registry
+from edl_tpu.utils.logging import kv_logger
+
+log = kv_logger("obs")
+
+METRICS_KV_PREFIX = "metrics"  # {job}/metrics/{worker} holds snapshot JSON
+
+
+def metrics_key(job: str, worker: str) -> str:
+    return f"{job}/{METRICS_KV_PREFIX}/{worker}"
+
+
+class MetricsPusher:
+    """Daemon thread publishing periodic registry snapshots.
+
+    ``publish(json_str)`` is injected (the worker wires a coordinator
+    ``kv_put`` with its own error handling) so this module stays free
+    of coordinator imports. A failing publish is logged once per
+    streak and retried next tick — telemetry must never take the step
+    loop down.
+    """
+
+    def __init__(
+        self,
+        publish: Callable[[str], None],
+        interval_s: float = 10.0,
+        registry: Optional[MetricsRegistry] = None,
+    ):
+        self._publish = publish
+        self.interval_s = max(float(interval_s), 0.1)
+        self.registry = registry or default_registry()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._failing = False
+        self.pushes = 0
+
+    def push_once(self) -> bool:
+        try:
+            self._publish(self.registry.snapshot_json())
+            self.pushes += 1
+            self._failing = False
+            return True
+        except Exception as e:
+            if not self._failing:
+                log.warn("metrics push failed (will retry)", error=str(e))
+                self._failing = True
+            return False
+
+    def start(self) -> "MetricsPusher":
+        def _run():
+            while not self._stop.wait(self.interval_s):
+                self.push_once()
+
+        self._thread = threading.Thread(
+            target=_run, name="edl-metrics-push", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, final_push: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        if final_push:
+            self.push_once()  # last-gasp snapshot so a clean exit is visible
+
+
+def aggregate_snapshots(
+    snaps: Dict[str, str | dict], reg: Optional[MetricsRegistry] = None
+) -> MetricsRegistry:
+    """Merge per-source snapshot JSONs into one registry, labeling
+    every series ``worker=<source>``. Undecodable snapshots are
+    skipped (a half-written KV value must not kill the scrape)."""
+    reg = reg or MetricsRegistry()
+    for worker, raw in sorted(snaps.items()):
+        try:
+            snap = json.loads(raw) if isinstance(raw, str) else raw
+            reg.merge_snapshot(snap, labels={"worker": worker})
+        except (ValueError, TypeError) as e:
+            log.warn("bad metrics snapshot", worker=worker, error=str(e))
+    return reg
+
+
+def collect_fleet(client, job: str, extra_sources: Iterable[str] = ()) -> MetricsRegistry:
+    """Coordinator-side aggregation pass: read every live member's
+    pushed snapshot (plus well-known non-member sources like the
+    epoch's dist_service host) from KV and merge. Rebuilt per scrape —
+    counters stay correct because each pass starts from an empty
+    registry."""
+    names = [m.name for m in client.members()]
+    names.extend(extra_sources)
+    snaps: Dict[str, str] = {}
+    for name in names:
+        v = client.kv_get(metrics_key(job, name))
+        if v:
+            snaps[name] = v
+    reg = aggregate_snapshots(snaps)
+    g = reg.gauge("edl_fleet_reporting_workers", "workers with a pushed metrics snapshot")
+    g.set(len(snaps))
+    return reg
+
+
+# ---------------------------------------------------------------------------
+# tracer -> histogram bridge
+
+
+def bridge_tracer(
+    registry: Optional[MetricsRegistry] = None, tracer=None
+) -> Callable:
+    """Subscribe a registry to the process tracer: every recorded span
+    becomes an ``edl_span_seconds{name=...}`` observation, so span
+    timings (reshard phases, checkpoint I/O, serving blocks) are
+    scrapeable as histograms, not just dumpable as a trace. Returns
+    the installed listener (pass to ``Tracer.remove_listener`` to
+    detach)."""
+    from edl_tpu.utils import tracing
+
+    reg = registry or default_registry()
+    tr = tracer or tracing.tracer()
+    hist = reg.histogram(
+        "edl_span_seconds", "tracer span durations by name", ("name",)
+    )
+
+    def _on_span(span) -> None:
+        hist.observe(span.dur_s, name=span.name)
+
+    tr.add_listener(_on_span)
+    return _on_span
+
+
+# ---------------------------------------------------------------------------
+# MonitorSample -> registry (the controller/StoreSource exposition path)
+
+
+def registry_from_sample(sample, reg: Optional[MetricsRegistry] = None) -> MetricsRegistry:
+    """Map one :class:`~edl_tpu.monitor.collector.MonitorSample` (any
+    source: ClusterSource, StoreSource, ServingSource) onto gauges —
+    the controller daemon re-exposes its census this way, and the
+    round trip (sample -> registry -> text -> parse) is pinned by
+    tests/test_obs.py."""
+    reg = reg or MetricsRegistry()
+    g = reg.gauge
+    g("edl_fleet_cpu_total_milli", "cluster CPU capacity (millicores)").set(
+        sample.cpu_total_milli
+    )
+    g("edl_fleet_cpu_request_milli", "cluster CPU requested (millicores)").set(
+        sample.cpu_request_milli
+    )
+    g("edl_fleet_chip_total", "cluster accelerator chips").set(sample.chip_total)
+    g("edl_fleet_chip_request", "cluster chips requested").set(sample.chip_request)
+    g("edl_fleet_cpu_util_pct", "CPU utilization percent").set(sample.cpu_util)
+    g("edl_fleet_chip_util_pct", "chip utilization percent").set(sample.chip_util)
+    g("edl_fleet_jobs", "job census", ("state",)).set(
+        len(sample.submitted_jobs), state="submitted"
+    )
+    reg.get("edl_fleet_jobs").set(len(sample.pending_jobs), state="pending")
+    workers = g("edl_job_workers", "running workers", ("job",))
+    target = g("edl_job_parallelism", "autoscaler target parallelism", ("job",))
+    reshards = g("edl_job_reshards", "reshard count (sampled)", ("job",))
+    stall = g("edl_job_last_reshard_stall_seconds", "last reshard stall", ("job",))
+    fallbacks = g("edl_job_reshard_fallbacks", "host-staged reshards (sampled)", ("job",))
+    for name in sample.submitted_jobs:
+        workers.set(sample.running_workers.get(name, 0), job=name)
+        target.set(sample.parallelism.get(name, 0), job=name)
+        reshards.set(sample.reshards.get(name, 0), job=name)
+        stall.set(sample.last_stall_s.get(name, 0.0), job=name)
+        fallbacks.set(sample.reshard_fallbacks.get(name, 0), job=name)
+    if sample.serving:
+        sv = g("edl_serving_snapshot", "serving engine snapshot values", ("key",))
+        for k, v in sorted(sample.serving.items()):
+            sv.set(float(v), key=k)
+    return reg

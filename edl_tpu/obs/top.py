@@ -1,0 +1,123 @@
+"""`edl top` — one-screen live view of any telemetry endpoint.
+
+Scrapes ``/metrics`` (+ ``/healthz``) from an exporter — a serving
+process, a training worker, or the coordinator's fleet aggregation —
+and renders the headline series: training step-time breakdown,
+serving TTFT/ITL percentiles and queue, reshard stalls, checkpoint
+I/O. Works against any Prometheus endpoint that uses the edl metric
+catalog (doc/observability.md); series carrying a ``worker`` label
+(the aggregated fleet view) are summed/percentiled across workers.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Tuple
+
+from edl_tpu.obs.exporter import scrape
+from edl_tpu.obs.metrics import parse_prometheus_text, percentile_from_buckets
+
+_Fams = Dict[str, List[Tuple[Dict[str, str], float]]]
+
+
+def _total(fams: _Fams, name: str, **match: str) -> float:
+    out = 0.0
+    for labels, v in fams.get(name, ()):
+        if all(labels.get(k) == val for k, val in match.items()):
+            out += v
+    return out
+
+
+def _pctls(fams: _Fams, name: str, qs=(0.5, 0.95, 0.99)) -> List[float]:
+    pairs = fams.get(name + "_bucket", [])
+    return [percentile_from_buckets(pairs, q) for q in qs]
+
+
+def _ms(v: float) -> str:
+    return f"{v * 1e3:.1f}ms" if v < 1.0 else f"{v:.2f}s"
+
+
+def summarize(fams: _Fams) -> List[str]:
+    """Render the parsed families into the one-screen text block."""
+    lines: List[str] = []
+
+    steps = _total(fams, "edl_train_steps_total")
+    if steps or fams.get("edl_train_step_seconds_count"):
+        sp = _pctls(fams, "edl_train_step_seconds")
+        dw = _pctls(fams, "edl_train_data_wait_seconds", (0.5,))
+        hb = _pctls(fams, "edl_train_host_block_seconds", (0.5,))
+        eps = _total(fams, "edl_train_examples_per_sec")
+        loss = _total(fams, "edl_train_loss")
+        lines.append(
+            f"TRAIN    steps={steps:.0f} "
+            f"step p50/p95/p99={_ms(sp[0])}/{_ms(sp[1])}/{_ms(sp[2])} "
+            f"data_wait p50={_ms(dw[0])} host_block p50={_ms(hb[0])}"
+        )
+        lines.append(
+            f"         rows/s={eps:.1f} loss={loss:.6g} "
+            f"examples={_total(fams, 'edl_train_examples_total'):.0f}"
+        )
+
+    tokens = _total(fams, "edl_serving_tokens_total")
+    ttft_n = _total(fams, "edl_serving_ttft_seconds_count")
+    if tokens or ttft_n:
+        tp = _pctls(fams, "edl_serving_ttft_seconds")
+        ip = _pctls(fams, "edl_serving_itl_seconds", (0.5,))
+        disp = _total(fams, "edl_serving_dispatch_total")
+        lines.append(
+            f"SERVING  ttft p50/p95/p99={_ms(tp[0])}/{_ms(tp[1])}/{_ms(tp[2])} "
+            f"itl p50={_ms(ip[0])} tokens={tokens:.0f}"
+        )
+        lines.append(
+            f"         queue={_total(fams, 'edl_serving_queue_depth'):.0f} "
+            f"active_slots={_total(fams, 'edl_serving_active_slots'):.0f} "
+            f"dispatches={disp:.0f}"
+            + (f" disp/tok={disp / tokens:.3f}" if tokens else "")
+        )
+
+    nre = _total(fams, "edl_reshard_total")
+    if nre:
+        rp = _pctls(fams, "edl_reshard_stall_seconds")
+        host = _total(fams, "edl_reshard_total", path="host")
+        lines.append(
+            f"RESHARD  count={nre:.0f} "
+            f"stall p50/p95/p99={rp[0]:.2f}/{rp[1]:.2f}/{rp[2]:.2f}s "
+            f"host_fallbacks={host:.0f}"
+        )
+
+    saves = _total(fams, "edl_checkpoint_save_seconds_count")
+    if saves:
+        sp = _pctls(fams, "edl_checkpoint_save_seconds", (0.5,))
+        lines.append(
+            f"CKPT     saves={saves:.0f} save p50={sp[0]:.3f}s "
+            f"bytes={_total(fams, 'edl_checkpoint_bytes_total'):.0f}"
+        )
+
+    workers = _total(fams, "edl_fleet_reporting_workers")
+    if workers:
+        lines.append(f"FLEET    reporting_workers={workers:.0f}")
+    chip_total = _total(fams, "edl_fleet_chip_total")
+    if chip_total:
+        lines.append(
+            f"FLEET    chips={_total(fams, 'edl_fleet_chip_request'):.0f}"
+            f"/{chip_total:.0f} "
+            f"cpu={_total(fams, 'edl_fleet_cpu_util_pct'):.1f}% "
+            f"jobs={_total(fams, 'edl_fleet_jobs', state='submitted'):.0f}"
+        )
+
+    if not lines:
+        lines.append("(no edl series observed yet)")
+    return lines
+
+
+def top_once(endpoint: str, timeout_s: float = 5.0) -> str:
+    """One scrape, rendered. ``endpoint`` is host:port or a URL."""
+    text = scrape(endpoint, "/metrics", timeout_s=timeout_s)
+    header = endpoint
+    try:
+        hz = json.loads(scrape(endpoint, "/healthz", timeout_s=timeout_s))
+        header = f"{endpoint}  up {hz.get('uptime_s', 0):.0f}s pid {hz.get('pid', '?')}"
+    except Exception:
+        pass  # /healthz is optional: any Prometheus endpoint works
+    body = summarize(parse_prometheus_text(text))
+    return "\n".join([f"EDL TOP  {header}"] + body)

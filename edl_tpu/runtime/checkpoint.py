@@ -29,6 +29,7 @@ import json
 import os
 import tempfile
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -36,9 +37,32 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.parallel import sharding as shd
 from edl_tpu.parallel.mesh import MeshPlan
 from edl_tpu.train.trainer import TrainState, shard_state
+from edl_tpu.utils import tracing
+
+
+def _obs_io(direction: str, kind: str, dt_s: float, nbytes: int) -> None:
+    """Checkpoint I/O telemetry: duration histograms by format kind
+    (dense single-file vs multi-process shards) + a bytes counter —
+    scrapeable alongside the checkpoint.* tracer spans."""
+    r = obs_metrics.default_registry()
+    name = (
+        "edl_checkpoint_save_seconds"
+        if direction == "write"
+        else "edl_checkpoint_restore_seconds"
+    )
+    help = (
+        "checkpoint write time" if direction == "write"
+        else "checkpoint read/restore time"
+    )
+    r.histogram(name, help, ("kind",)).observe(dt_s, kind=kind)
+    if nbytes:
+        r.counter(
+            "edl_checkpoint_bytes_total", "checkpoint bytes moved", ("op",)
+        ).inc(nbytes, op=direction)
 
 
 def snapshot(state: TrainState) -> TrainState:
@@ -247,6 +271,7 @@ def _flatten(tree) -> Dict[str, np.ndarray]:
 def save(path: str, state: TrainState, metadata: Dict[str, Any] = None) -> None:
     """Atomic npz checkpoint: params + opt_state + step + metadata in ONE
     file, published by a single rename (no torn meta/state pair)."""
+    t0 = time.perf_counter()
     os.makedirs(path, exist_ok=True)
     host = snapshot(state) if not isinstance(state.step, np.ndarray) else state
     payload = {
@@ -262,13 +287,22 @@ def save(path: str, state: TrainState, metadata: Dict[str, Any] = None) -> None:
     with open(tmp, "wb") as f:
         np.savez(f, **payload)
     os.replace(tmp, os.path.join(path, "state.npz"))
+    _obs_io(
+        "write", "dense", time.perf_counter() - t0,
+        sum(int(v.nbytes) for v in payload.values()),
+    )
 
 
 def load(path: str, like: TrainState) -> TrainState:
     """Load into the structure of ``like`` (a template state — freshly
     initialized params/opt_state define the tree)."""
+    t0 = time.perf_counter()
     with np.load(os.path.join(path, "state.npz")) as z:
         data = {k: z[k] for k in z.files}
+    _obs_io(
+        "read", "dense", time.perf_counter() - t0,
+        sum(int(v.nbytes) for v in data.values()),
+    )
 
     def _fill(tree, prefix):
         treedef = jax.tree_util.tree_structure(tree)
@@ -453,6 +487,7 @@ def save_shards(
     with a complete (dp-replicated) snapshot must persist leaves whose
     replica 0 lived on the dead peer. Returns the shard filename (for
     the leader's manifest)."""
+    t0 = time.perf_counter()
     d = step_dir(root, snap.step)
     os.makedirs(d, exist_ok=True)
     payload: Dict[str, np.ndarray] = {}
@@ -469,9 +504,14 @@ def save_shards(
     fname = shard_filename(rank, world)
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz.tmp")
     os.close(fd)
-    with open(tmp, "wb") as f:
-        np.savez(f, **payload)
-    os.replace(tmp, os.path.join(d, fname))
+    with tracing.span("checkpoint.save_shards", step=snap.step, rank=rank):
+        with open(tmp, "wb") as f:
+            np.savez(f, **payload)
+        os.replace(tmp, os.path.join(d, fname))
+    _obs_io(
+        "write", "shards", time.perf_counter() - t0,
+        sum(int(a.nbytes) for a in payload.values()),
+    )
     return fname
 
 
@@ -830,17 +870,20 @@ def load_sharded(
         ram = None  # stale/ahead RAM: disk manifest is the agreed truth
     shapes = {k: tuple(v) for k, v in manifest["shapes"].items()}
     index = _PieceIndex(manifest, ram, shapes=shapes)
+    t0 = time.perf_counter()
     try:
-        return _materialize(
-            index,
-            manifest["step"],
-            like,
-            state_shardings,
-            shapes,
-            manifest["dtypes"],
-        )
+        with tracing.span("checkpoint.load_sharded", step=manifest["step"]):
+            return _materialize(
+                index,
+                manifest["step"],
+                like,
+                state_shardings,
+                shapes,
+                manifest["dtypes"],
+            )
     finally:
         index.close()
+        _obs_io("read", "shards", time.perf_counter() - t0, 0)
 
 
 def template_schema(like: TrainState) -> Tuple[Dict[str, Tuple[int, ...]], Dict[str, str]]:

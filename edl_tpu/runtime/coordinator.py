@@ -27,10 +27,29 @@ import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
+from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.runtime.data import ElasticDataQueue, Task
 from edl_tpu.utils.logging import kv_logger
 
 log = kv_logger("coordinator")
+
+
+def _rpc_counters():
+    """RPC-volume telemetry for the coordination plane (a chatty
+    rendezvous loop or a KV hot spot shows up as a per-op counter on
+    /metrics, not just as mystery latency). Resolved per call so a
+    registry swap in tests takes effect."""
+    r = obs_metrics.default_registry()
+    return (
+        r.counter(
+            "edl_coordinator_rpc_total",
+            "coordinator client round trips", ("op",),
+        ),
+        r.counter(
+            "edl_coordinator_reconnects_total",
+            "coordinator client reconnect attempts",
+        ),
+    )
 
 _NATIVE_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
@@ -346,14 +365,18 @@ class CoordinatorClient:
         return resp.decode().rstrip("\n")
 
     def _call(self, line: str) -> str:
+        rpcs, reconnects = _rpc_counters()
         with self._lock:
             deadline = time.monotonic() + self._reconnect_window_s
             backoff = 0.05
             while True:
                 try:
-                    return self._roundtrip(line)
+                    out = self._roundtrip(line)
+                    rpcs.inc(op=line.split(" ", 1)[0])
+                    return out
                 except (ConnectionError, OSError, socket.timeout) as e:
                     self.close()
+                    reconnects.inc()
                     if time.monotonic() >= deadline:
                         raise ConnectionError(
                             f"coordinator unreachable after "

@@ -7,10 +7,18 @@ wrapper resolves/builds the native server (native/coordinator) and
 execs it, so the container's PID-1 signal handling applies to the
 server itself.
 
+With ``--metrics-port`` the wrapper instead SUPERVISES the server as a
+child and runs the job's fleet telemetry endpoint alongside it: every
+worker pushes metric snapshots into this coordinator's KV
+(``{job}/metrics/{worker}``, obs/fleet.py), and each scrape of
+``/metrics`` here re-exposes the aggregated union with every series
+labeled by worker — the one-stop Prometheus target for the whole job.
+
 Used by the KubeCluster coordinator Deployment
 (edl_tpu/cluster/kube.py) and handy for manual bring-up:
 
-    python -m edl_tpu.runtime.coordinator_main --port 7164
+    python -m edl_tpu.runtime.coordinator_main --port 7164 \
+        [--metrics-port 9100 --job myjob]
 """
 
 from __future__ import annotations
@@ -18,6 +26,11 @@ from __future__ import annotations
 import argparse
 import os
 import sys
+import time
+
+# non-member sources whose snapshots the aggregation also reads (the
+# epoch's dist_service host pushes under this reserved name)
+EXTRA_METRIC_SOURCES = ("dist_service",)
 
 
 def main(argv=None) -> int:
@@ -27,18 +40,59 @@ def main(argv=None) -> int:
         "--member-ttl", type=float, default=10.0,
         help="seconds without heartbeat before a member is reaped",
     )
+    ap.add_argument(
+        "--metrics-port", type=int, default=None,
+        help="serve the fleet-aggregated telemetry endpoint on this "
+        "port (0 = ephemeral; prints the bound URL). Aggregates the "
+        "metric snapshots workers push into this coordinator's KV.",
+    )
+    ap.add_argument(
+        "--job", default="job",
+        help="job name for the metrics KV prefix ({job}/metrics/*); "
+        "only used with --metrics-port",
+    )
     a = ap.parse_args(argv)
 
-    from edl_tpu.runtime.coordinator import _BIN_PATH, ensure_native_built
+    from edl_tpu.runtime.coordinator import (
+        _BIN_PATH,
+        CoordinatorServer,
+        ensure_native_built,
+    )
 
     if not ensure_native_built():
         print("native coordinator unavailable (no toolchain?)", file=sys.stderr)
         return 1
-    os.execv(
-        _BIN_PATH,
-        [_BIN_PATH, "--port", str(a.port), "--member-ttl", str(a.member_ttl)],
+
+    if a.metrics_port is None:
+        os.execv(
+            _BIN_PATH,
+            [_BIN_PATH, "--port", str(a.port), "--member-ttl", str(a.member_ttl)],
+        )
+        return 0  # unreachable
+
+    # supervised mode: server child + aggregation exporter in this
+    # process (telemetry rides the same pod, same lifecycle)
+    from edl_tpu import obs
+
+    server = CoordinatorServer(port=a.port, member_ttl_s=a.member_ttl)
+    client = server.client()
+    exporter = obs.start_exporter(
+        lambda: obs.collect_fleet(client, a.job, EXTRA_METRIC_SOURCES),
+        port=a.metrics_port,
     )
-    return 0  # unreachable
+    print(
+        f"coordinator on :{a.port}; fleet metrics at {exporter.url}/metrics",
+        flush=True,
+    )
+    try:
+        while server._proc.poll() is None:
+            time.sleep(0.5)
+        return server._proc.returncode or 0
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        exporter.stop()
+        server.stop()
 
 
 if __name__ == "__main__":

@@ -67,6 +67,7 @@ def main(argv=None) -> int:
     ap.add_argument("--orphan-grace", type=float, default=600.0)
     a = ap.parse_args(argv)
 
+    from edl_tpu import obs
     from edl_tpu.runtime.coordinator import CoordinatorClient
 
     host, port = a.coordinator.rsplit(":", 1)
@@ -76,10 +77,30 @@ def main(argv=None) -> int:
     cl.kv_put(f"{a.job}/dist/{a.epoch}", f"{a.bind_host}:{svc_port}")
     done_key = f"{a.job}/dist_done/{a.epoch}/{svc_port}"
     print(f"dist_service up epoch={a.epoch} port={svc_port}", flush=True)
+    # fleet instrumentation: the rendezvous plane reports its own
+    # liveness under the reserved "dist_service" source name, so the
+    # coordinator's aggregated /metrics shows whether (and for which
+    # epoch) a coordination-service host is up (obs/fleet.py,
+    # coordinator_main.EXTRA_METRIC_SOURCES)
+    t_up = time.monotonic()
+    reg = obs.MetricsRegistry()
+    g_up = reg.gauge(
+        "edl_dist_service_up", "coordination-service host liveness", ("epoch",)
+    )
+    g_up.set(1, epoch=str(a.epoch))
+    g_uptime = reg.gauge(
+        "edl_dist_service_uptime_seconds", "coordination-service host uptime"
+    )
+    metrics_kv = obs.metrics_key(a.job, "dist_service")
+    last_push = 0.0
     orphan_since = None
     try:
         while True:
             try:
+                if time.monotonic() - last_push >= 5.0:
+                    g_uptime.set(time.monotonic() - t_up)
+                    cl.kv_put(metrics_kv, reg.snapshot_json())
+                    last_push = time.monotonic()
                 if cl.kv_get(done_key):
                     # we are the only reader: retire the mark ourselves
                     # so the coordinator KV stays O(live state) without
@@ -101,6 +122,11 @@ def main(argv=None) -> int:
                 break  # coordinator gone: the job is over
             time.sleep(0.5)
     finally:
+        try:  # last-gasp: the fleet view shows a clean DOWN, not staleness
+            g_up.set(0, epoch=str(a.epoch))
+            cl.kv_put(metrics_kv, reg.snapshot_json())
+        except Exception:
+            pass
         svc.shutdown()
     return 0
 

@@ -41,10 +41,24 @@ from edl_tpu.train.trainer import (
     make_train_step,
     shard_state,
 )
+from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.utils import tracing
 from edl_tpu.utils.logging import Timer, kv_logger
 
 log = kv_logger("elastic")
+
+
+def _obs_reshard(ev: "ReshardEvent") -> None:
+    """Reshard telemetry (the BASELINE north-star, scrapeable): stall
+    histogram + path-labeled counter — previously this lived only in
+    tracing spans a human had to dump."""
+    r = obs_metrics.default_registry()
+    r.histogram(
+        "edl_reshard_stall_seconds", "traffic-stopping reshard window"
+    ).observe(ev.stall_s)
+    r.counter("edl_reshard_total", "elastic reshards", ("path",)).inc(
+        path="host" if ev.fallback else "device"
+    )
 
 
 def _device_reshard(state: TrainState, plan: MeshPlan, mesh, pspecs) -> TrainState:
@@ -332,6 +346,7 @@ class ElasticTrainer:
             fallback=used_fallback,
         )
         self.report.reshards.append(ev)
+        _obs_reshard(ev)
         log.info(
             "reshard done",
             from_workers=prev,
@@ -346,11 +361,34 @@ class ElasticTrainer:
 
     def train_steps(self, data_fn: Callable[[int], Any], n_steps: int) -> TrainReport:
         """Run ``n_steps`` updates; ``data_fn(global_batch_size)`` yields a
-        host batch each step (task-queue readers plug in here)."""
+        host batch each step (task-queue readers plug in here).
+
+        Every step records its wall time and the data-wait share into
+        the process registry (edl_train_step_seconds /
+        edl_train_data_wait_seconds); the end-of-call materialization
+        is the host-block share. Pure host bookkeeping — nothing is
+        synced that the loop didn't already sync."""
+        reg = obs_metrics.default_registry()
+        h_step = reg.histogram(
+            "edl_train_step_seconds",
+            "full step wall time (data + dispatch + sync)",
+        )
+        h_data = reg.histogram(
+            "edl_train_data_wait_seconds",
+            "host wait for the next batch (data stall)",
+        )
+        h_block = reg.histogram(
+            "edl_train_host_block_seconds",
+            "host blocked on device results (sync stall)",
+        )
+        c_examples = reg.counter(
+            "edl_train_examples_total", "training rows consumed"
+        )
         t0 = time.perf_counter()
         raw_losses = []  # device arrays; materialized once after the loop
         for _ in range(n_steps):
             self._maybe_rescale()
+            ts = time.perf_counter()
             batch = data_fn(self.global_batch_size)
             dev_batch = global_batch(batch, self.plan, self.mesh)
             first_on_mesh = (
@@ -358,6 +396,7 @@ class ElasticTrainer:
                 and self.report.reshards[-1].recompile_s == 0.0
             )
             tc = time.perf_counter()
+            h_data.observe(tc - ts)
             if self._stepper is not None:
                 self.state, metrics = self._stepper.step(self.state, dev_batch)
                 if (self._host_step + 1) % self.sync_every == 0:
@@ -372,12 +411,29 @@ class ElasticTrainer:
                     "reshard.recompile", tc, recompile_s,
                     {"to_workers": self.n_workers},
                 )
+                obs_metrics.default_registry().histogram(
+                    "edl_reshard_recompile_seconds",
+                    "first-step compile on the new mesh",
+                ).observe(recompile_s)
             self.report.steps += 1
             self._host_step += 1
             self.report.examples += self.global_batch_size
+            c_examples.inc(self.global_batch_size)
             raw_losses.append(metrics["loss"])
             self.maybe_checkpoint()
+            h_step.observe(time.perf_counter() - ts)
+        tb = time.perf_counter()
         jax.block_until_ready(self.state.params)
+        h_block.observe(time.perf_counter() - tb)
         self.report.train_seconds += time.perf_counter() - t0
         self.report.losses.extend(float(x) for x in raw_losses)
+        if raw_losses:
+            reg.gauge("edl_train_loss", "most recent training loss").set(
+                float(raw_losses[-1])
+            )
+        if self.report.train_seconds > 0:
+            reg.gauge(
+                "edl_train_examples_per_sec",
+                "training throughput over the last report window",
+            ).set(self.report.examples_per_sec)
         return self.report

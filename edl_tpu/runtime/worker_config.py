@@ -103,6 +103,17 @@ class WorkerConfig:
     # measured loss-parity window). ~1/6 of the 2x rate win for an
     # update path whose error is bf16 rounding, not quantization.
     int8_wgrad_bf16: bool = False
+    # telemetry (edl_tpu/obs): EDL_METRICS_PORT >= 0 starts the HTTP
+    # exporter (/metrics Prometheus text, /trace chrome-trace JSON,
+    # /healthz) on that port (0 = ephemeral; the bound port is
+    # published in coordinator KV at {job}/metrics_addr/{worker} so
+    # `edl top` can find it). -1 = no exporter.
+    metrics_port: int = -1
+    # cadence of metric-snapshot pushes into coordinator KV
+    # ({job}/metrics/{worker}) for the coordinator's fleet-aggregated
+    # /metrics (runtime/coordinator_main.py --metrics-port). 0 = no
+    # pushes. Matches the reference collector's 10 s census period.
+    metrics_push_s: float = 10.0
     # TPU slice this host belongs to (multi-slice topology). -1 =
     # unknown: the mesh build falls back to the hardware's own
     # ``device.slice_index`` (real multislice TPU exposes it). When set
@@ -157,6 +168,8 @@ class WorkerConfig:
             eval_device=e.get("EDL_EVAL_DEVICE", ""),
             int8_mxu=e.get("EDL_INT8_MXU", "0") == "1",
             int8_wgrad_bf16=e.get("EDL_INT8_WGRAD_BF16", "0") == "1",
+            metrics_port=int(e.get("EDL_METRICS_PORT", "-1")),
+            metrics_push_s=float(e.get("EDL_METRICS_PUSH_S", "10")),
             # MEGASCALE_SLICE_ID is what GKE injects into multislice
             # TPU pods — honoring it makes the kube path slice-aware
             # with no manifest change

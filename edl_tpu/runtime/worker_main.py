@@ -215,10 +215,60 @@ class ElasticWorker:
         self._eval = ExportEvaluator(cfg, self._k)
         self._incarnation = 0  # set at bootstrap; bumped to force regroup
         self._restore_failures = 0
+        self._exporter = None  # obs.MetricsExporter when EDL_METRICS_PORT set
+        self._pusher = None  # obs.MetricsPusher when metrics_push_s > 0
 
     # -- keys ----------------------------------------------------------------
     def _k(self, *parts: str) -> str:
         return "/".join((self.cfg.job,) + parts)
+
+    # -- telemetry (edl_tpu/obs) ---------------------------------------------
+    def _telemetry_start(self) -> None:
+        """Bring up this worker's observability surface: the process
+        registry (full core catalog + tracer bridge, so reshard/
+        checkpoint spans are scrapeable as histograms), the optional
+        HTTP exporter, and the periodic snapshot push into coordinator
+        KV that feeds the coordinator's fleet-aggregated /metrics.
+        Telemetry failures degrade to warnings — never the job."""
+        from edl_tpu import obs
+
+        cfg = self.cfg
+        obs.ensure_core_series()
+        obs.bridge_tracer()
+        if cfg.metrics_port >= 0:
+            try:
+                self._exporter = obs.start_exporter(port=cfg.metrics_port)
+                # advertise the bound (possibly ephemeral) port so
+                # `edl top` / scrapers can discover it through KV
+                self.client.kv_put(
+                    self._k("metrics_addr", cfg.worker_id),
+                    f"127.0.0.1:{self._exporter.port}",
+                )
+            except OSError as e:
+                log.warn("metrics exporter failed to bind", error=str(e))
+        if cfg.metrics_push_s > 0:
+            key = obs.metrics_key(cfg.job, cfg.worker_id)
+            # the main client is lock-serialized per roundtrip, so the
+            # pusher thread can share it (same pattern would hold for a
+            # dedicated connection; sharing avoids a third socket)
+            self._pusher = obs.MetricsPusher(
+                lambda payload: self.client.kv_put(key, payload),
+                interval_s=cfg.metrics_push_s,
+            ).start()
+
+    def _telemetry_stop(self) -> None:
+        if self._pusher is not None:
+            try:
+                self._pusher.stop(final_push=True)
+            except Exception:  # pragma: no cover - teardown best-effort
+                pass
+            self._pusher = None
+        if self._exporter is not None:
+            try:
+                self._exporter.stop()
+            except Exception:  # pragma: no cover
+                pass
+            self._exporter = None
 
     # -- SIGTERM: graceful drain --------------------------------------------
     def _on_sigterm(self, signum, frame):  # pragma: no cover - signal path
@@ -654,6 +704,7 @@ class ElasticWorker:
         ctx = entrypoint.bootstrap(self.client)
         self._incarnation = ctx.incarnation
         heartbeat_stop = self._start_heartbeat(ctx.incarnation)
+        self._telemetry_start()
         try:
             return self._epochs(cfg, jax, MeshPlan, wl, tx)
         except Exception as e:
@@ -661,6 +712,7 @@ class ElasticWorker:
             raise
         finally:
             heartbeat_stop.set()
+            self._telemetry_stop()
 
     def _start_heartbeat(self, incarnation: int) -> threading.Event:
         """TTL keep-alive on its own connection (steps may outlast the
@@ -985,6 +1037,25 @@ class ElasticWorker:
         verb. The crash path cannot merge (the mesh just failed), so it
         skips the RAM snapshot and rolls back to the last commit."""
         from edl_tpu.runtime import checkpoint as ckpt
+        from edl_tpu.obs import metrics as obs_metrics
+
+        reg = obs_metrics.default_registry()
+        h_step = reg.histogram(
+            "edl_train_step_seconds",
+            "full step wall time (data + dispatch + sync)",
+        )
+        h_data = reg.histogram(
+            "edl_train_data_wait_seconds",
+            "host wait for the next batch (data stall)",
+        )
+        h_block = reg.histogram(
+            "edl_train_host_block_seconds",
+            "host blocked on device results (sync stall)",
+        )
+        c_examples = reg.counter(
+            "edl_train_examples_total", "training rows consumed"
+        )
+        g_loss = reg.gauge("edl_train_loss", "most recent training loss")
 
         go_key = self._k("go", str(epoch))
         sharding = plan.batch_sharding(mesh)
@@ -997,6 +1068,7 @@ class ElasticWorker:
             else:
                 verb = self._await_go(cl, go_key, i, members)
             if verb in ("step", "ckpt"):
+                t_iter = time.perf_counter()
                 local, task_id = self._local_batch(cl, batch_fn)
                 gbatch = jax.tree_util.tree_map(
                     lambda x: jax.make_array_from_process_local_data(
@@ -1004,6 +1076,7 @@ class ElasticWorker:
                     ),
                     local,
                 )
+                h_data.observe(time.perf_counter() - t_iter)
                 try:
                     if stepper is not None:
                         new_state, metrics = stepper.step(state, gbatch)
@@ -1011,7 +1084,9 @@ class ElasticWorker:
                             new_state = stepper.sync(new_state)
                     else:
                         new_state, metrics = step(state, gbatch)
+                    t_sync = time.perf_counter()
                     loss = float(jax.device_get(metrics["loss"]))
+                    h_block.observe(time.perf_counter() - t_sync)
                 except Exception as e:
                     # peer died mid-collective: recover from last
                     # completed state (crash path; epoch will bump once
@@ -1036,6 +1111,9 @@ class ElasticWorker:
                     self._await_peer_reaped(cl, epoch)
                     return "reshard"
                 state = new_state
+                c_examples.inc(self._local_rows)
+                g_loss.set(loss)
+                h_step.observe(time.perf_counter() - t_iter)
                 if task_id is not None:
                     cl.ack(task_id)
                 if cfg.step_sleep_s:

@@ -83,6 +83,7 @@ from edl_tpu.serving.scheduler import (
     Request,
     RequestQueue,
 )
+from edl_tpu.utils import tracing
 from edl_tpu.utils.logging import kv_logger
 
 log = kv_logger("serving")
@@ -407,11 +408,16 @@ class ContinuousBatchingEngine:
     def _dispatch_block(self) -> None:
         old = (self._dtok, self._dpos, self._dact, self._drem,
                self._kc, self._vc)
-        (toks, self._dtok, self._dpos, self._dact, self._drem,
-         self._kc, self._vc) = self._decode(
-            self.params, old[0], old[1], old[2], old[3], self._deos,
-            old[4], old[5], self._next_key(), self._temp(),
-        )
+        # span measures the ENQUEUE cost only (the dispatch is async);
+        # the device-side block time shows up as serving.drain on the
+        # block that finally syncs it — together they are the
+        # dispatch/block breakdown the obs bridge exposes
+        with tracing.span("serving.dispatch", horizon=self.horizon):
+            (toks, self._dtok, self._dpos, self._dact, self._drem,
+             self._kc, self._vc) = self._decode(
+                self.params, old[0], old[1], old[2], old[3], self._deos,
+                old[4], old[5], self._next_key(), self._temp(),
+            )
         self.metrics.on_dispatch("decode")
         self._assert_donated(*old)
         self._inflight.append(toks)
@@ -423,7 +429,8 @@ class ContinuousBatchingEngine:
         read -1 and terminate the row's replay — the device freezes a
         row at exactly the step the host would finish it, so the two
         views never disagree."""
-        out = np.asarray(self._inflight.popleft())
+        with tracing.span("serving.drain"):
+            out = np.asarray(self._inflight.popleft())
         emitted = 0
         for i in range(self.max_slots):
             sl = self._slots[i]
@@ -480,25 +487,27 @@ class ContinuousBatchingEngine:
             prefill = _prefill_program(self.cfg, tb, self._sampling)
             old = (self._dtok, self._dpos, self._dact, self._drem,
                    self._deos, self._kc, self._vc)
-            (tok0, self._dtok, self._dpos, self._dact, self._drem,
-             self._deos, self._kc, self._vc) = prefill(
-                self.params,
-                jnp.asarray(toks),
-                jnp.int32(t0 - 1),
-                jnp.int32(slot),
-                jnp.int32(req.max_new),
-                jnp.int32(-1 if req.eos_id is None else req.eos_id),
-                old[0], old[1], old[2], old[3], old[4], old[5], old[6],
-                self._next_key(),
-                self._temp(),
-            )
-            self.metrics.on_dispatch("prefill")
-            self._assert_donated(*old)
-            # admission is a sync point by design: the first token IS
-            # the TTFT sample, so it must be observed now, not a block
-            # later (and any block dispatched before this admission
-            # completed on device as a dependency of the prefill)
-            tok0 = int(np.asarray(tok0))
+            with tracing.span("serving.prefill", bucket=tb):
+                (tok0, self._dtok, self._dpos, self._dact, self._drem,
+                 self._deos, self._kc, self._vc) = prefill(
+                    self.params,
+                    jnp.asarray(toks),
+                    jnp.int32(t0 - 1),
+                    jnp.int32(slot),
+                    jnp.int32(req.max_new),
+                    jnp.int32(-1 if req.eos_id is None else req.eos_id),
+                    old[0], old[1], old[2], old[3], old[4], old[5], old[6],
+                    self._next_key(),
+                    self._temp(),
+                )
+                self.metrics.on_dispatch("prefill")
+                self._assert_donated(*old)
+                # admission is a sync point by design: the first token
+                # IS the TTFT sample, so it must be observed now, not a
+                # block later (and any block dispatched before this
+                # admission completed on device as a dependency of the
+                # prefill)
+                tok0 = int(np.asarray(tok0))
             self.metrics.on_admit(req.rid, t0)
             sl = _Slot(
                 rid=req.rid, max_new=req.max_new,

@@ -1,13 +1,18 @@
-"""Serving metrics — TTFT, tokens/s, queue depth, slot occupancy,
-request outcome counters.
+"""Serving metrics — TTFT/ITL latency histograms, tokens/s, queue
+depth, slot occupancy, request outcome counters.
 
 The training side publishes load through ``monitor/collector.py`` so
 the autoscaler can act on it; serving publishes through the SAME
 plumbing (``monitor.collector.ServingSource`` wraps
 :meth:`ServingMetrics.snapshot`), so a future autoscaler consumes
-serving load exactly like training load. Pure host bookkeeping — the
-engine calls the ``on_*`` hooks from its step loop; nothing here
-touches jax. ``clock`` is injectable so tests are deterministic.
+serving load exactly like training load. Additionally every hook
+records into an :class:`~edl_tpu.obs.metrics.MetricsRegistry`
+(default: the process-wide one), which is what the obs HTTP exporter
+scrapes — ``edl_serving_ttft_seconds`` / ``edl_serving_itl_seconds``
+histograms, dispatch/request counters, queue/slot gauges. Pure host
+bookkeeping — the engine calls the ``on_*`` hooks from its step loop;
+nothing here touches jax. ``clock`` is injectable so tests are
+deterministic.
 """
 
 from __future__ import annotations
@@ -17,12 +22,21 @@ from collections import Counter
 from dataclasses import dataclass
 from typing import Dict, Optional
 
+from edl_tpu.obs import metrics as obs_metrics
+
+# sub-ms..minutes: TTFT on a loaded box can hit seconds (queue wait +
+# prefill), ITL sits at sub-ms..tens of ms; the shared ladder keeps
+# fleet merges exact
+_LATENCY_BUCKETS = obs_metrics.DEFAULT_BUCKETS
+
 
 @dataclass
 class _ReqRecord:
+    has_submit: bool = False  # submit_s is meaningful (0.0 is a valid time)
     submit_s: float = 0.0
     admit_s: float = 0.0
     first_token_s: float = 0.0
+    last_token_s: float = 0.0
     finish_s: float = 0.0
     prompt_len: int = 0
     tokens: int = 0
@@ -38,7 +52,10 @@ class ServingMetrics:
     depth, active slots, slot occupancy (mean active/max over decode
     steps). Latency: per-request TTFT (first generated token, which
     lands with the prefill, minus submit) and tokens/s; aggregate
-    tokens/s over the busy window (first admission to last token).
+    tokens/s over the busy window (first admission to last token);
+    TTFT and inter-token-latency HISTOGRAMS with p50/p95/p99 in
+    :meth:`snapshot` (obs fixed-bucket type, so the percentiles a
+    scraper derives from /metrics match the snapshot's).
 
     Token accounting is PER-BLOCK under a fused decode horizon: the
     engine drains a block's [slots, H] token matrix in one go and
@@ -46,10 +63,17 @@ class ServingMetrics:
     read, n tokens). TTFT is NOT distorted by that batching — the
     first token always lands with the prefill at admission, which
     stays a synchronous :meth:`on_token`, so ``ttft_*`` measures
-    prefill latency, never block-drain latency."""
+    prefill latency, never block-drain latency. ITL under a block is
+    one weighted observation of the per-token mean across the drain
+    gap — exact in count and sum, bucketed at the mean."""
 
-    def __init__(self, clock=time.monotonic):
+    def __init__(
+        self,
+        clock=time.monotonic,
+        registry: Optional[obs_metrics.MetricsRegistry] = None,
+    ):
         self.clock = clock
+        self.registry = registry or obs_metrics.default_registry()
         self.submitted = 0
         self.admitted = 0
         self.completed = 0
@@ -65,17 +89,57 @@ class ServingMetrics:
         self._active_now = 0
         self._t_first_admit: Optional[float] = None
         self._t_last_token: Optional[float] = None
+        r = self.registry
+        self._m_requests = r.counter(
+            "edl_serving_requests_total", "request lifecycle events", ("event",)
+        )
+        self._m_tokens = r.counter("edl_serving_tokens_total", "generated tokens")
+        self._m_dispatch = r.counter(
+            "edl_serving_dispatch_total", "device program dispatches", ("kind",)
+        )
+        # per-ENGINE histograms back the snapshot percentiles (several
+        # engines may share the process registry; their union belongs
+        # on /metrics, not in one engine's snapshot) …
+        self.ttft_hist = obs_metrics.Histogram(
+            "ttft_s", "per-engine TTFT", buckets=_LATENCY_BUCKETS
+        )
+        self.itl_hist = obs_metrics.Histogram(
+            "itl_s", "per-engine ITL", buckets=_LATENCY_BUCKETS
+        )
+        # … and the registry-resident twins are what the exporter
+        # scrapes (identical bucket ladder, so the two views agree)
+        self._r_ttft = r.histogram(
+            "edl_serving_ttft_seconds",
+            "time to first token (submit -> first token)",
+            buckets=_LATENCY_BUCKETS,
+        )
+        self._r_itl = r.histogram(
+            "edl_serving_itl_seconds",
+            "inter-token latency (per generated token)",
+            buckets=_LATENCY_BUCKETS,
+        )
+        self._m_queue = r.gauge(
+            "edl_serving_queue_depth", "requests waiting for a KV slot"
+        )
+        self._m_active = r.gauge("edl_serving_active_slots", "occupied KV slots")
+        self._m_occupancy = r.gauge(
+            "edl_serving_slot_occupancy", "mean active/max slots over decode steps"
+        )
 
     # -- engine hooks -------------------------------------------------------
 
     def on_submit(self, rid: str) -> None:
         self.submitted += 1
-        self.requests[rid] = _ReqRecord(submit_s=self.clock())
+        self.requests[rid] = _ReqRecord(has_submit=True, submit_s=self.clock())
+        self._m_requests.inc(event="submitted")
 
     def on_reject(self, rid: str, reason: str) -> None:
         self.rejected[reason] += 1
-        rec = self.requests.setdefault(rid, _ReqRecord(submit_s=self.clock()))
+        rec = self.requests.setdefault(
+            rid, _ReqRecord(has_submit=True, submit_s=self.clock())
+        )
         rec.outcome = f"rejected:{reason}"
+        self._m_requests.inc(event="rejected")
 
     def on_admit(self, rid: str, prompt_len: int) -> None:
         self.admitted += 1
@@ -84,6 +148,7 @@ class ServingMetrics:
         rec.prompt_len = prompt_len
         if self._t_first_admit is None:
             self._t_first_admit = rec.admit_s
+        self._m_requests.inc(event="admitted")
 
     def on_token(self, rid: str) -> None:
         """One generated token (the first lands with the prefill)."""
@@ -97,14 +162,30 @@ class ServingMetrics:
         rec = self.requests.setdefault(rid, _ReqRecord())
         if rec.tokens == 0:
             rec.first_token_s = now
+            if rec.has_submit:
+                ttft = now - rec.submit_s
+                self.ttft_hist.observe(ttft)
+                self._r_ttft.observe(ttft)
+            if n > 1:
+                # tokens beyond the first in the same drain: zero
+                # observable inter-token gap at this clock resolution
+                self.itl_hist.observe(0.0, n=n - 1)
+                self._r_itl.observe(0.0, n=n - 1)
+        elif rec.last_token_s:
+            itl = (now - rec.last_token_s) / n
+            self.itl_hist.observe(itl, n=n)
+            self._r_itl.observe(itl, n=n)
+        rec.last_token_s = now
         rec.tokens += n
         self.tokens_out += n
         self._t_last_token = now
+        self._m_tokens.inc(n)
 
     def on_dispatch(self, kind: str) -> None:
         """One device program dispatch (``decode`` = a fused horizon
         block, ``prefill`` = an admission insert)."""
         self.dispatches[kind] += 1
+        self._m_dispatch.inc(kind=kind)
 
     def on_finish(self, rid: str, outcome: str) -> None:
         self.completed += 1
@@ -112,6 +193,7 @@ class ServingMetrics:
         rec = self.requests.setdefault(rid, _ReqRecord())
         rec.outcome = outcome
         rec.finish_s = self.clock()
+        self._m_requests.inc(event="completed")
 
     def on_step(self, active_slots: int, max_slots: int, queue_depth: int):
         """One engine iteration (decode step or idle-admit pass)."""
@@ -120,6 +202,15 @@ class ServingMetrics:
         self._max_slots = max(self._max_slots, max_slots)
         self._active_now = active_slots
         self._queue_depth = queue_depth
+        self._m_queue.set(queue_depth)
+        self._m_active.set(active_slots)
+        # occupancy is a slow-moving running mean — refreshing the
+        # mirror gauge every 16 steps keeps the per-step hook under
+        # the 1% overhead budget on tiny CPU-dryrun blocks
+        if self._max_slots and (self._steps & 15) == 0:
+            self._m_occupancy.set(
+                self._active_slot_steps / (self._steps * self._max_slots)
+            )
 
     # -- views --------------------------------------------------------------
 
@@ -164,6 +255,18 @@ class ServingMetrics:
             ),
             "ttft_avg_s": sum(ttfts) / len(ttfts) if ttfts else 0.0,
             "ttft_max_s": max(ttfts) if ttfts else 0.0,
+            # histogram-derived percentiles (same interpolation a
+            # PromQL histogram_quantile over /metrics would give).
+            # NOTE: the backing histograms are registry-resident, so
+            # with the shared default registry they aggregate across
+            # every engine in the process — construct with a private
+            # registry for per-engine isolation.
+            "ttft_p50_s": self.ttft_hist.percentile(0.50),
+            "ttft_p95_s": self.ttft_hist.percentile(0.95),
+            "ttft_p99_s": self.ttft_hist.percentile(0.99),
+            "itl_p50_s": self.itl_hist.percentile(0.50),
+            "itl_p95_s": self.itl_hist.percentile(0.95),
+            "itl_p99_s": self.itl_hist.percentile(0.99),
             "agg_tokens_per_s": self.tokens_out / busy if busy > 0 else 0.0,
             "dispatches_decode": float(self.dispatches["decode"]),
             "dispatches_prefill": float(self.dispatches["prefill"]),

@@ -9,6 +9,7 @@ params/optimizer state sharded per the mesh plan, gradients all-reduced
 
 from __future__ import annotations
 
+import time
 from functools import partial
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -18,8 +19,25 @@ import optax
 from flax import struct
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.parallel.mesh import MeshPlan
 from edl_tpu.parallel import sharding as shd
+
+
+def _record_dispatch(dt_s: float, n_steps: int = 1) -> None:
+    """Step-factory telemetry choke point: every compiled update path
+    (per-step, scan-fused, delayed-sync) counts optimizer steps and
+    times the DISPATCH (enqueue) — the async call itself, not device
+    time; a blocking dispatch here means the pipeline is full, which
+    is exactly the host-side signal worth scraping. Looked up per call
+    so a test's registry swap takes effect immediately; cost is two
+    dict hits."""
+    r = obs_metrics.default_registry()
+    r.histogram(
+        "edl_train_dispatch_seconds",
+        "train-step program dispatch (enqueue) time",
+    ).observe(dt_s)
+    r.counter("edl_train_steps_total", "optimizer steps completed").inc(n_steps)
 
 
 @struct.dataclass
@@ -147,7 +165,10 @@ def make_train_step(
                     donate_argnums=(0,) if donate else (),
                 )
             )
-        return cell[0](state, batch)
+        t = time.perf_counter()
+        out = cell[0](state, batch)
+        _record_dispatch(time.perf_counter() - t)
+        return out
 
     return step
 
@@ -200,7 +221,11 @@ def make_train_multistep(
                     donate_argnums=(0,) if donate else (),
                 )
             )
-        return cell[0](state, batches)
+        t = time.perf_counter()
+        out = cell[0](state, batches)
+        k = jax.tree_util.tree_leaves(batches)[0].shape[0]
+        _record_dispatch(time.perf_counter() - t, n_steps=k)
+        return out
 
     return multi
 
@@ -354,7 +379,10 @@ class LocalSyncStepper:
 
     def step(self, lstate: TrainState, batch):
         """One local step on every group — no cross-group collectives."""
-        return self._step(lstate, batch)
+        t = time.perf_counter()
+        out = self._step(lstate, batch)
+        _record_dispatch(time.perf_counter() - t)
+        return out
 
 
 def stack_batches(batches, plan: MeshPlan, mesh: Mesh):

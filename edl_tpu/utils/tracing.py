@@ -21,8 +21,9 @@ import json
 import os
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Any, Dict, Iterator, List, Optional
+from typing import Any, Callable, Deque, Dict, Iterator, List, Optional
 
 from edl_tpu.utils.logging import kv_logger
 
@@ -39,15 +40,27 @@ class Span:
 
 
 class Tracer:
-    """Thread-safe in-memory span recorder."""
+    """Thread-safe in-memory span recorder.
+
+    The buffer is a bounded RING: past ``max_spans`` the OLDEST span
+    is evicted and ``dropped`` counts evictions — an always-on tracer
+    must keep the spans closest to the incident, and the old
+    drop-newest policy silently threw away exactly those (a reshard
+    storm after a long soak recorded nothing). The eviction count
+    surfaces in :meth:`summary` (the ``_tracer`` entry) and in the
+    chrome-trace metadata, so a truncated trace is never mistaken for
+    a complete one. ``add_listener`` subscribes observers (the obs
+    bridge turns spans into scrapeable histograms) — listeners run
+    outside the lock and must be cheap/non-throwing."""
 
     def __init__(self, max_spans: int = 100_000):
         self._lock = threading.Lock()
-        self._spans: List[Span] = []
+        self._spans: Deque[Span] = deque(maxlen=max_spans)
         self._t0 = time.perf_counter()
         self.max_spans = max_spans
         self.enabled = True
-        self.dropped = 0  # spans discarded after the buffer filled
+        self.dropped = 0  # spans evicted after the ring filled
+        self._listeners: List[Callable[[Span], None]] = []
 
     @contextlib.contextmanager
     def span(self, name: str, **attrs: Any) -> Iterator[None]:
@@ -66,19 +79,36 @@ class Tracer:
         tracer start so chrome-trace timestamps line up across threads."""
         if not self.enabled:
             return
+        span = Span(name, start_s - self._t0, dur_s, dict(attrs or {}),
+                    threading.get_ident())
         with self._lock:
-            if len(self._spans) < self.max_spans:
-                self._spans.append(
-                    Span(name, start_s - self._t0, dur_s, dict(attrs or {}),
-                         threading.get_ident())
-                )
-            else:
+            if len(self._spans) >= self.max_spans:
+                # ring semantics: evict the OLDEST, keep the new span
                 if self.dropped == 0:
                     log.warn(
-                        "span buffer full; dropping further spans",
+                        "span ring full; evicting oldest spans",
                         max_spans=self.max_spans,
                     )
                 self.dropped += 1
+            self._spans.append(span)
+            listeners = list(self._listeners)
+        for fn in listeners:
+            try:
+                fn(span)
+            except Exception as e:  # telemetry must never take us down
+                log.warn("span listener failed", error=str(e))
+
+    def add_listener(self, fn: Callable[[Span], None]) -> None:
+        """Subscribe ``fn(span)`` to every recorded span (called
+        outside the tracer lock, after the span is stored)."""
+        with self._lock:
+            if fn not in self._listeners:
+                self._listeners.append(fn)
+
+    def remove_listener(self, fn: Callable[[Span], None]) -> None:
+        with self._lock:
+            if fn in self._listeners:
+                self._listeners.remove(fn)
 
     def spans(self, name: Optional[str] = None) -> List[Span]:
         with self._lock:
@@ -91,13 +121,18 @@ class Tracer:
             self.dropped = 0
 
     def summary(self) -> Dict[str, Dict[str, float]]:
-        """Per-span-name {count, total_s, max_s} rollup."""
+        """Per-span-name {count, total_s, max_s} rollup, plus a
+        ``_tracer`` meta entry carrying the ring-buffer accounting
+        (retained span count + evictions) so a truncated window is
+        visible to every summary consumer."""
+        spans = self.spans()
         out: Dict[str, Dict[str, float]] = {}
-        for s in self.spans():
+        for s in spans:
             agg = out.setdefault(s.name, {"count": 0, "total_s": 0.0, "max_s": 0.0})
             agg["count"] += 1
             agg["total_s"] += s.dur_s
             agg["max_s"] = max(agg["max_s"], s.dur_s)
+        out["_tracer"] = {"spans": len(spans), "dropped": self.dropped}
         return out
 
     def to_chrome_trace(self) -> List[Dict[str, Any]]:
@@ -116,15 +151,33 @@ class Tracer:
             for s in self.spans()
         ]
 
+    def to_chrome_doc(self) -> Dict[str, Any]:
+        """Full chrome-trace JSON document: the events plus a metadata
+        ("M") event and top-level ``dropped``, so a viewer AND a raw
+        reader both see ring-buffer truncation. Served by the obs
+        exporter's ``/trace`` and written by :meth:`dump`."""
+        events = self.to_chrome_trace()
+        events.append(
+            {
+                "name": "edl_tracer",
+                "ph": "M",
+                "pid": os.getpid(),
+                "tid": 0,
+                "args": {
+                    "dropped": self.dropped,
+                    "max_spans": self.max_spans,
+                    "spans": len(events),
+                },
+            }
+        )
+        return {"traceEvents": events, "dropped": self.dropped}
+
     def dump(self, path: str) -> None:
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
         with open(path, "w") as f:
-            json.dump(
-                {"traceEvents": self.to_chrome_trace(), "dropped": self.dropped},
-                f,
-            )
+            json.dump(self.to_chrome_doc(), f)
         log.info(
             "trace written",
             path=path,

@@ -37,8 +37,17 @@ not regressed to per-token dispatch — decode dispatches must satisfy
 ``dispatches/token <= 1/H + admission overhead`` (partial tail blocks
 counted), and H=8 must cut dispatches/token >= 4x vs H=1.
 
+``--metrics-port`` brings up the obs HTTP exporter for the run
+(0 = ephemeral); in the dryrun lane the script then SCRAPES its own
+``/metrics`` and hard-asserts the key series are present and non-zero
+(TTFT histogram, dispatch counters, queue gauge, plus the training/
+reshard catalog lines) — valid Prometheus exposition is CI-enforced,
+and the exporter-on overhead bound (<=1% tokens/s) is ~the noise
+floor because instrumentation is pure host counters off the dispatch
+path.
+
     python scripts/exp_serving.py [--requests N] [--slots B]
-        [--horizons 1,8] [--dryrun]
+        [--horizons 1,8] [--dryrun] [--metrics-port 0]
 """
 
 import argparse
@@ -150,6 +159,53 @@ def sweep_horizons(params, cfg, reqs, slots, max_len, horizons, check=False):
     return rows
 
 
+def check_scrape(exporter) -> None:
+    """The CI exposition contract (run_tests.sh phase 4): GET /metrics
+    must return valid Prometheus text with the serving series NON-ZERO
+    after a workload (TTFT histogram, decode+prefill dispatch
+    counters, queue/slot gauges observed) and the training + reshard
+    catalog present, so the whole schema is scrape-discoverable from
+    a serving process."""
+    from edl_tpu import obs
+
+    text = obs.scrape(exporter.url)
+    fams = obs.parse_prometheus_text(text)
+
+    def total(series, **match):
+        return sum(
+            v for labels, v in fams.get(series, ())
+            if all(labels.get(k) == mv for k, mv in match.items())
+        )
+
+    ttft_n = total("edl_serving_ttft_seconds_count")
+    assert ttft_n > 0, "TTFT histogram has no observations"
+    assert total("edl_serving_tokens_total") > 0, "token counter is zero"
+    assert total("edl_serving_dispatch_total", kind="decode") > 0
+    assert total("edl_serving_dispatch_total", kind="prefill") > 0
+    assert "edl_serving_queue_depth" in fams, "queue gauge missing"
+    assert total("edl_serving_itl_seconds_count") > 0, "ITL histogram empty"
+    # the full catalog renders even on a serving-only process:
+    # unlabeled training/reshard series as zero-valued samples,
+    # labeled families at least as schema (TYPE) lines
+    for name in ("edl_train_step_seconds_count", "edl_reshard_stall_seconds_count"):
+        assert name in fams, f"{name} absent"
+    for typeline in (
+        "# TYPE edl_checkpoint_save_seconds histogram",
+        "# TYPE edl_reshard_total counter",
+    ):
+        assert typeline in text, f"{typeline!r} absent"
+    # span bridge: the engine's dispatch/prefill/drain spans scrape as
+    # histograms
+    assert total("edl_span_seconds_count", name="serving.dispatch") > 0
+    p50 = obs.percentile_from_buckets(
+        fams["edl_serving_ttft_seconds_bucket"], 0.5
+    )
+    print(
+        f"scrape OK: {len(fams)} families, ttft n={ttft_n:.0f} "
+        f"p50={p50 * 1e3:.1f}ms"
+    )
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--requests", type=int, default=0, help="0 = auto")
@@ -164,8 +220,22 @@ def main() -> None:
         help="CI smoke lane: horizon sweep only, tiny model, hard "
         "dispatch-bound assertions",
     )
+    ap.add_argument(
+        "--metrics-port", type=int, default=None,
+        help="serve /metrics, /trace, /healthz during the run "
+        "(0 = ephemeral); with --dryrun the script self-scrapes and "
+        "hard-asserts the key serving series",
+    )
     args = ap.parse_args()
     horizons = [int(h) for h in args.horizons.split(",") if h]
+
+    exporter = None
+    if args.metrics_port is not None:
+        from edl_tpu import obs
+
+        obs.bridge_tracer()
+        exporter = obs.start_exporter(port=args.metrics_port)
+        print(f"metrics endpoint: {exporter.url}/metrics")
 
     from edl_tpu.models import llama
     from edl_tpu.monitor.collector import Collector, ServingSource
@@ -201,6 +271,9 @@ def main() -> None:
         deep = build_workload(8, cfg.vocab, rng, on_tpu, deep=True)
         sweep_horizons(params, cfg, deep, slots, max(max_len, 96),
                        sorted(set(horizons) | {1, 8}), check=True)
+        if exporter is not None:
+            check_scrape(exporter)
+            exporter.stop()
         print("dryrun OK")
         return
 

@@ -39,11 +39,14 @@ rc3=$?
 t3=$(date +%s)
 echo "== phase 3 done in $((t3 - t2))s (rc=$rc3) =="
 
-echo "== phase 4: serving dispatch-bound smoke (exp_serving --dryrun) =="
+echo "== phase 4: serving dispatch-bound + telemetry smoke (exp_serving --dryrun) =="
 # hard-asserts dispatches/token <= 1/H + admission overhead and the
 # >=4x H=8-vs-H=1 reduction, so the fused decode loop can't silently
-# regress to per-token dispatch
-JAX_PLATFORMS=cpu python scripts/exp_serving.py --dryrun
+# regress to per-token dispatch. --metrics-port 0 additionally brings
+# up the obs exporter and self-scrapes /metrics, hard-asserting the
+# key series (TTFT histogram, dispatch counters, queue gauge) are
+# present and non-zero — the Prometheus exposition path is CI-pinned.
+JAX_PLATFORMS=cpu python scripts/exp_serving.py --dryrun --metrics-port 0
 rc4=$?
 t4=$(date +%s)
 echo "== phase 4 done in $((t4 - t3))s (rc=$rc4) =="

@@ -245,3 +245,85 @@ def test_job_status_reads_live_coordinator(tmp_path, capsys):
     # unreachable coordinator is a clean error, not a traceback
     assert main(["job-status", "x", "--coordinator", "127.0.0.1:1"]) == 1
     assert "cannot reach" in capsys.readouterr().err
+
+
+def test_monitor_json_emits_machine_readable_samples(tmp_path, capsys):
+    """`edl monitor --json` — JSONL twin of the text table, tailable
+    by scripts and the future autoscaler."""
+    store_dir = str(tmp_path / "store")
+    m = _write_manifest(tmp_path, "jm")
+    assert main(["submit", m, "--store", store_dir]) == 0
+    assert main(["controller", "--store", store_dir, "--tick-s", "0",
+                 "--iterations", "3"]) == 0
+    capsys.readouterr()
+    assert main(["monitor", "--store", store_dir, "--polls", "2",
+                 "--interval", "0", "--json"]) == 0
+    lines = [
+        ln for ln in capsys.readouterr().out.splitlines() if ln.strip()
+    ]
+    assert len(lines) == 2
+    for ln in lines:
+        rec = json.loads(ln)  # one JSON object per poll, no table text
+        assert rec["submitted_jobs"] == ["jm"]
+        assert rec["chip_total"] == 32
+        assert rec["running_workers"]["jm"] >= 2
+        assert 0.0 <= rec["chip_util"] <= 100.0
+
+
+def test_controller_fleet_exporter_scrapes_census():
+    """`edl controller --metrics-port` — the scrapeable twin of the
+    monitor: each /metrics GET samples the live cluster census."""
+    from types import SimpleNamespace
+
+    from edl_tpu import obs
+    from edl_tpu.api.job import TrainingJob
+    from edl_tpu.cli.main import _build_cluster, _start_fleet_exporter
+
+    args = SimpleNamespace(
+        hosts=2, chips_per_host=8, host_cpu_milli=96_000,
+        host_mem_mega=393_216, metrics_port=0,
+    )
+    cluster = _build_cluster(args)
+    cluster.submit_job(TrainingJob.from_yaml(ELASTIC_YAML.format(name="fx")))
+    cluster.reconcile()
+    exp = _start_fleet_exporter(args, cluster)
+    try:
+        fams = obs.parse_prometheus_text(obs.scrape(exp.url))
+        assert fams["edl_fleet_chip_total"] == [({}, 16.0)]
+        (labels, _), = fams["edl_job_parallelism"]
+        assert labels == {"job": "fx"}
+    finally:
+        exp.stop()
+    # metrics_port None -> no exporter
+    args.metrics_port = None
+    assert _start_fleet_exporter(args, cluster) is None
+
+
+def test_edl_top_renders_one_screen_view(capsys):
+    """`edl top ENDPOINT` — scrape + summarize the headline series."""
+    from edl_tpu import obs
+
+    reg = obs.MetricsRegistry()
+    obs.ensure_core_series(reg)
+    reg.get("edl_serving_tokens_total").inc(120)
+    reg.get("edl_serving_ttft_seconds").observe(0.03)
+    reg.get("edl_serving_queue_depth").set(3)
+    reg.get("edl_serving_dispatch_total").inc(20, kind="decode")
+    reg.get("edl_train_steps_total").inc(7)
+    reg.get("edl_train_step_seconds").observe(0.02)
+    reg.get("edl_reshard_total").inc(2, path="device")
+    reg.get("edl_reshard_stall_seconds").observe(1.5)
+    exp = obs.start_exporter(reg, port=0)
+    endpoint = f"127.0.0.1:{exp.port}"
+    try:
+        assert main(["top", endpoint, "--polls", "1"]) == 0
+        out = capsys.readouterr().out
+        assert "EDL TOP" in out
+        assert "SERVING" in out and "tokens=120" in out and "queue=3" in out
+        assert "TRAIN" in out and "steps=7" in out
+        assert "RESHARD" in out and "count=2" in out
+    finally:
+        exp.stop()
+    # dead endpoint: clean error, not a traceback
+    assert main(["top", endpoint, "--polls", "1", "--timeout", "0.5"]) == 1
+    assert "scrape failed" in capsys.readouterr().err

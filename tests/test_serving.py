@@ -464,6 +464,7 @@ def test_cli_serve_jsonl(tmp_path):
         [
             sys.executable, "-m", "edl_tpu.cli", "serve", str(tmp_path),
             "--requests", str(feed), "--max-slots", "2", "--max-len", "32",
+            "--metrics-port", "0",
         ],
         capture_output=True, text=True, env=_env(),
     )
@@ -475,6 +476,10 @@ def test_cli_serve_jsonl(tmp_path):
     assert recs[0]["outcome"] == "done" and recs[0]["ttft_s"] >= 0
     assert recs[2]["outcome"] == "rejected:budget"
     assert "SERVING:" in out.stderr and "rejected=1" in out.stderr
+    # obs surface: --metrics-port announces the endpoint and the
+    # histogram-backed percentiles render in the final SERVING block
+    assert "# metrics endpoint http://127.0.0.1:" in out.stderr
+    assert "latency: ttft p50/p95/p99=" in out.stderr
 
 
 def test_cli_serve_stdin_and_flag_validation(tmp_path):

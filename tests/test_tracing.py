@@ -40,18 +40,56 @@ def test_span_recording_and_chrome_dump(tmp_path):
     assert [s.name for s in tr.spans()] == ["inner", "outer"]
     assert tr.spans("outer")[0].attrs == {"job": "j"}
     assert tr.summary()["outer"]["count"] == 1
+    assert tr.summary()["_tracer"] == {"spans": 2, "dropped": 0}
 
     g_path = str(tmp_path / "t.json")
     tr.dump(g_path)
     with open(g_path) as f:
-        events = json.load(f)["traceEvents"]
+        doc = json.load(f)
+    assert doc["dropped"] == 0
+    events = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
     assert len(events) == 2
-    assert all(e["ph"] == "X" and e["dur"] >= 0 for e in events)
+    assert all(e["dur"] >= 0 for e in events)
+    # the ring-buffer accounting rides as chrome-trace metadata
+    assert meta and meta[0]["args"]["dropped"] == 0
     # inner nests within outer on the timeline
     inner = next(e for e in events if e["name"] == "inner")
     outer = next(e for e in events if e["name"] == "outer")
     assert outer["ts"] <= inner["ts"]
     assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1.0
+
+
+def test_ring_buffer_keeps_most_recent_spans():
+    """Overflow policy: the ring evicts the OLDEST span (the old
+    behavior silently dropped the NEWEST — exactly the spans closest
+    to an incident) and the eviction count surfaces everywhere."""
+    tr = tracing.Tracer(max_spans=3)
+    for i in range(7):
+        tr.record(f"s{i}", 0.0, 0.1)
+    assert [s.name for s in tr.spans()] == ["s4", "s5", "s6"]
+    assert tr.dropped == 4
+    assert tr.summary()["_tracer"] == {"spans": 3, "dropped": 4}
+    doc = tr.to_chrome_doc()
+    assert doc["dropped"] == 4
+    meta = next(e for e in doc["traceEvents"] if e["ph"] == "M")
+    assert meta["args"]["dropped"] == 4 and meta["args"]["max_spans"] == 3
+    tr.clear()
+    assert tr.dropped == 0 and tr.spans() == []
+
+
+def test_tracer_listener_sees_every_span():
+    tr = tracing.Tracer()
+    seen = []
+    listener = lambda s: seen.append(s.name)  # noqa: E731
+    tr.add_listener(listener)
+    with tr.span("a"):
+        pass
+    tr.record("b", 0.0, 0.5)
+    assert seen == ["a", "b"]
+    tr.remove_listener(listener)
+    tr.record("c", 0.0, 0.5)
+    assert seen == ["a", "b"]
 
 
 def test_reshard_emits_spans(cpu_devices):
