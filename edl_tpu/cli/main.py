@@ -625,12 +625,14 @@ def run_generate(args) -> int:
     return 0
 
 
-def _read_serve_requests(path: str, default_max_new: int, default_eos):
+def _read_serve_requests(
+    path: str, default_max_new: int, default_eos, default_deadline_s=None
+):
     """Parse the ``edl serve`` JSONL request feed (``-`` = stdin):
     one object per line, ``{"prompt": [ids], "id"?, "max_new"?,
-    "eos"?}``. Returns a list of dicts or raises ValueError — parsed
-    BEFORE the export loads, so a malformed feed never costs a multi-GB
-    load."""
+    "eos"?, "deadline_s"?}``. Returns a list of dicts or raises
+    ValueError — parsed BEFORE the export loads, so a malformed feed
+    never costs a multi-GB load."""
     if path == "-":
         lines = sys.stdin.read().splitlines()
     else:
@@ -653,12 +655,16 @@ def _read_serve_requests(path: str, default_max_new: int, default_eos):
         ):
             raise ValueError(f"line {i + 1}: prompt must be a list of ints")
         eos = obj.get("eos", default_eos)
+        dl = obj.get("deadline_s", default_deadline_s)
         out.append(
             {
                 "id": str(obj.get("id", f"req-{i + 1}")),
                 "prompt": prompt,
                 "max_new": int(obj.get("max_new", default_max_new)),
                 "eos": None if eos is None or int(eos) < 0 else int(eos),
+                "deadline_s": (
+                    None if dl is None or float(dl) <= 0 else float(dl)
+                ),
             }
         )
     if not out:
@@ -690,10 +696,15 @@ def run_serve(args) -> int:
     if args.horizon < 1:
         print(f"--horizon must be >= 1, got {args.horizon}", file=sys.stderr)
         return 1
+    if args.max_recoveries < 0:
+        print(f"--max-recoveries must be >= 0, got {args.max_recoveries}",
+              file=sys.stderr)
+        return 1
     try:
         requests = _read_serve_requests(
             args.requests, args.max_new,
             None if args.eos < 0 else args.eos,
+            None if args.deadline_s <= 0 else args.deadline_s,
         )
     except (OSError, ValueError) as e:
         print(f"bad request feed: {e}", file=sys.stderr)
@@ -732,6 +743,7 @@ def run_serve(args) -> int:
         policy=InterleavePolicy(prefills_per_step=args.prefills_per_step),
         temperature=args.temperature,
         seed=args.seed,
+        max_recoveries=args.max_recoveries,
     )
     collector = Collector(ServingSource(metrics), out=sys.stderr)
 
@@ -749,7 +761,8 @@ def run_serve(args) -> int:
     rejected = {}
     for r in requests:
         try:
-            engine.submit(r["id"], r["prompt"], r["max_new"], r["eos"])
+            engine.submit(r["id"], r["prompt"], r["max_new"], r["eos"],
+                          deadline_s=r["deadline_s"])
         except AdmissionError as e:
             rejected[r["id"]] = e
             log.warn("request rejected", rid=r["id"], reason=e.reason)
@@ -1068,7 +1081,7 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument(
         "--requests", default="-",
         help='JSONL request feed, one {"prompt": [ids], "id"?, '
-        '"max_new"?, "eos"?} per line ("-" = stdin)',
+        '"max_new"?, "eos"?, "deadline_s"?} per line ("-" = stdin)',
     )
     sv.add_argument(
         "--max-slots", type=int, default=8,
@@ -1100,6 +1113,17 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument(
         "--max-new", type=int, default=16,
         help="default token budget for requests that omit max_new",
+    )
+    sv.add_argument(
+        "--deadline-s", type=float, default=0.0,
+        help="default per-request latency budget in seconds: past it, "
+        "queued requests are shed (rejected:timeout) and in-flight "
+        "ones evicted with outcome timeout (0 = no deadline)",
+    )
+    sv.add_argument(
+        "--max-recoveries", type=int, default=2,
+        help="crash-safety: engine recovery passes a request may "
+        "consume before finishing with outcome failed",
     )
     sv.add_argument(
         "--eos", type=int, default=-1,

@@ -20,10 +20,12 @@ aggregation rebuilds from scratch each scrape — no delta protocol.
 from __future__ import annotations
 
 import json
+import random
 import threading
 from typing import Callable, Dict, Iterable, Optional
 
 from edl_tpu.obs.metrics import MetricsRegistry, default_registry
+from edl_tpu.utils import faults
 from edl_tpu.utils.logging import kv_logger
 
 log = kv_logger("obs")
@@ -41,8 +43,16 @@ class MetricsPusher:
     ``publish(json_str)`` is injected (the worker wires a coordinator
     ``kv_put`` with its own error handling) so this module stays free
     of coordinator imports. A failing publish is logged once per
-    streak and retried next tick — telemetry must never take the step
-    loop down.
+    streak and retried — telemetry must never take the step loop down.
+
+    Failed pushes back off with jittered exponential delay (reset on
+    the first success) instead of retrying every interval at full rate:
+    during a coordinator outage EVERY worker's pusher is failing at
+    once, and a fixed cadence turns the recovering coordinator's first
+    seconds into a synchronized retry stampede. The jitter (±50%)
+    decorrelates the fleet; ``backoff_cap_s`` bounds how stale a
+    recovered fleet's first snapshot can be. Each failure increments
+    ``edl_metrics_push_failures_total``.
     """
 
     def __init__(
@@ -50,30 +60,58 @@ class MetricsPusher:
         publish: Callable[[str], None],
         interval_s: float = 10.0,
         registry: Optional[MetricsRegistry] = None,
+        backoff_cap_s: float = 300.0,
     ):
         self._publish = publish
         self.interval_s = max(float(interval_s), 0.1)
+        self.backoff_cap_s = max(float(backoff_cap_s), self.interval_s)
         self.registry = registry or default_registry()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._failing = False
+        self._fail_streak = 0
+        # private PRNG: jitter must not perturb anyone's seeded
+        # random.random() stream (determinism elsewhere matters more
+        # than jitter quality)
+        self._rng = random.Random(id(self) ^ 0xED1)
         self.pushes = 0
 
     def push_once(self) -> bool:
         try:
+            # chaos site: the paths a real outage exercises are the
+            # registry serialize + the injected publish
+            faults.fault_point("metrics.push")
             self._publish(self.registry.snapshot_json())
             self.pushes += 1
             self._failing = False
+            self._fail_streak = 0
             return True
         except Exception as e:
+            self._fail_streak += 1
+            default_registry().counter(
+                "edl_metrics_push_failures_total",
+                "metrics snapshot pushes that raised",
+            ).inc()
             if not self._failing:
                 log.warn("metrics push failed (will retry)", error=str(e))
                 self._failing = True
             return False
 
+    def next_wait_s(self) -> float:
+        """Delay before the next push attempt: the fixed interval while
+        healthy; doubling from the interval per consecutive failure,
+        capped and jittered ±50%, while failing."""
+        if self._fail_streak == 0:
+            return self.interval_s
+        base = min(
+            self.interval_s * (2 ** min(self._fail_streak, 16)),
+            self.backoff_cap_s,
+        )
+        return base * (0.5 + self._rng.random())
+
     def start(self) -> "MetricsPusher":
         def _run():
-            while not self._stop.wait(self.interval_s):
+            while not self._stop.wait(self.next_wait_s()):
                 self.push_once()
 
         self._thread = threading.Thread(

@@ -469,6 +469,18 @@ def ensure_core_series(reg: Optional[MetricsRegistry] = None) -> MetricsRegistry
     r.gauge("edl_serving_queue_depth", "requests waiting for a KV slot")
     r.gauge("edl_serving_active_slots", "occupied KV slots")
     r.gauge("edl_serving_slot_occupancy", "mean active/max slots over decode steps")
+    r.counter(
+        "edl_serving_recoveries_total",
+        "engine crash-recovery passes (device state rebuilt, live "
+        "slots re-prefilled from prompt + generated)",
+    )
+    # robustness (doc/robustness.md)
+    r.counter("edl_faults_injected_total", "injected faults by site", ("site",))
+    r.counter("edl_metrics_push_failures_total", "metrics snapshot pushes that raised")
+    r.gauge(
+        "edl_worker_heartbeat_degraded",
+        "1 while the heartbeat loop cannot reach the coordinator",
+    )
     # elastic / reshard (the BASELINE north-star metric, scrapeable)
     r.counter("edl_reshard_total", "elastic reshards", ("path",))
     r.histogram("edl_reshard_stall_seconds", "traffic-stopping reshard window")

@@ -41,7 +41,7 @@ from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.parallel import sharding as shd
 from edl_tpu.parallel.mesh import MeshPlan
 from edl_tpu.train.trainer import TrainState, shard_state
-from edl_tpu.utils import tracing
+from edl_tpu.utils import faults, tracing
 
 
 def _obs_io(direction: str, kind: str, dt_s: float, nbytes: int) -> None:
@@ -271,6 +271,8 @@ def _flatten(tree) -> Dict[str, np.ndarray]:
 def save(path: str, state: TrainState, metadata: Dict[str, Any] = None) -> None:
     """Atomic npz checkpoint: params + opt_state + step + metadata in ONE
     file, published by a single rename (no torn meta/state pair)."""
+    # chaos site: the dense save IS its own commit (single rename)
+    faults.fault_point("ckpt.commit")
     t0 = time.perf_counter()
     os.makedirs(path, exist_ok=True)
     host = snapshot(state) if not isinstance(state.step, np.ndarray) else state
@@ -487,6 +489,7 @@ def save_shards(
     with a complete (dp-replicated) snapshot must persist leaves whose
     replica 0 lived on the dead peer. Returns the shard filename (for
     the leader's manifest)."""
+    faults.fault_point("ckpt.save")
     t0 = time.perf_counter()
     d = step_dir(root, snap.step)
     os.makedirs(d, exist_ok=True)
@@ -525,6 +528,10 @@ def write_manifest(
     schema, and the exact shard files. Written atomically, LAST — a
     step dir without a manifest is an aborted write and is ignored by
     loaders and reaped by :func:`gc_step_dirs`."""
+    # chaos site: a commit that fails here leaves an aborted (manifest-
+    # less) step dir, which loaders ignore and gc_step_dirs reaps — the
+    # crash-consistency property exp_chaos.py soaks
+    faults.fault_point("ckpt.commit")
     d = step_dir(root, snap.step)
     doc = {
         "step": snap.step,

@@ -29,6 +29,7 @@ from typing import Dict, List, Optional, Tuple
 
 from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.runtime.data import ElasticDataQueue, Task
+from edl_tpu.utils import faults
 from edl_tpu.utils.logging import kv_logger
 
 log = kv_logger("coordinator")
@@ -371,6 +372,10 @@ class CoordinatorClient:
             backoff = 0.05
             while True:
                 try:
+                    # chaos site: an armed "drop" raises ConnectionError
+                    # here, driving the REAL close/reconnect/backoff
+                    # path below (scripts/exp_chaos.py soaks this at 5%)
+                    faults.fault_point("coord.rpc")
                     out = self._roundtrip(line)
                     rpcs.inc(op=line.split(" ", 1)[0])
                     return out

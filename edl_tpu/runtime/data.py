@@ -17,6 +17,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
+from edl_tpu.utils import faults
+
 DEFAULT_LEASE_TIMEOUT_S = 16.0  # reference: -task-timout-dur=16s
 MAX_TASK_FAILURES = 3  # reference master's task failure cap analog
 
@@ -88,6 +90,9 @@ class ElasticDataQueue:
         """Lease the next task (reference: cloud_reader's master fetch).
         None when the epoch's tasks are all leased/done — the caller
         retries or finishes."""
+        # chaos site: a lost/late lease is redelivered by the timeout,
+        # the redelivery invariant exp_chaos.py soaks
+        faults.fault_point("data.lease")
         with self._lock:
             self._reap_expired()
             if not self._todo and not self._leases:

@@ -217,6 +217,7 @@ class ElasticWorker:
         self._restore_failures = 0
         self._exporter = None  # obs.MetricsExporter when EDL_METRICS_PORT set
         self._pusher = None  # obs.MetricsPusher when metrics_push_s > 0
+        self._hb_degraded = False  # heartbeat loop cut off from coordinator
 
     # -- keys ----------------------------------------------------------------
     def _k(self, *parts: str) -> str:
@@ -721,25 +722,12 @@ class ElasticWorker:
         us — the re-registration bumps the epoch, which correctly shows
         up to the group as a membership change."""
         stop = threading.Event()
-        cfg = self.cfg
-        interval = min(0.5, max(0.1, cfg.member_ttl_s / 4))
+        interval = min(0.5, max(0.1, self.cfg.member_ttl_s / 4))
 
         def _beat():  # pragma: no cover - timing-dependent
             c = None
             while not stop.wait(interval):
-                try:
-                    if c is None:
-                        c = CoordinatorClient(cfg.coord_host, cfg.coord_port, 5.0)
-                    if not c.heartbeat(cfg.worker_id) and not self._leaving:
-                        log.warn("TTL-evicted while alive; re-registering")
-                        c.register(cfg.worker_id, incarnation)
-                except Exception:
-                    try:
-                        if c is not None:
-                            c.close()
-                    except Exception:
-                        pass
-                    c = None
+                c = self._beat_tick(c, incarnation)
             if c is not None:
                 try:
                     c.close()
@@ -748,6 +736,50 @@ class ElasticWorker:
 
         threading.Thread(target=_beat, daemon=True).start()
         return stop
+
+    def _beat_tick(self, c, incarnation: int):
+        """One heartbeat attempt; returns the (re)usable client or None
+        after a failure. NEVER raises — a ConnectionError here (the
+        client's reconnect window exhausted during a long coordinator
+        outage) used to kill the beat thread, leaving the worker running
+        but silently TTL-expiring out of membership. Instead the worker
+        flips a degraded flag + gauge (``edl_worker_heartbeat_degraded``,
+        scrapeable so the fleet view shows WHO is beating blind) and
+        keeps retrying every tick until it departs; the first successful
+        beat clears the flag (and re-registers if the TTL already
+        evicted us)."""
+        from edl_tpu.obs import metrics as obs_metrics
+
+        cfg = self.cfg
+        gauge = obs_metrics.default_registry().gauge(
+            "edl_worker_heartbeat_degraded",
+            "1 while the heartbeat loop cannot reach the coordinator",
+        )
+        try:
+            if c is None:
+                c = CoordinatorClient(cfg.coord_host, cfg.coord_port, 5.0)
+            if not c.heartbeat(cfg.worker_id) and not self._leaving:
+                log.warn("TTL-evicted while alive; re-registering")
+                c.register(cfg.worker_id, incarnation)
+            if self._hb_degraded:
+                self._hb_degraded = False
+                gauge.set(0)
+                log.info("heartbeat recovered")
+            return c
+        except Exception as e:
+            if not self._hb_degraded:
+                self._hb_degraded = True
+                gauge.set(1)
+                log.warn(
+                    "heartbeat degraded; retrying until departure",
+                    error=f"{type(e).__name__}: {e}",
+                )
+            try:
+                if c is not None:
+                    c.close()
+            except Exception:
+                pass
+            return None
 
     def _epochs(self, cfg, jax, MeshPlan, wl, tx) -> int:
         from edl_tpu.train.trainer import make_train_step

@@ -61,6 +61,27 @@ correctness contract ``tests/test_serving.py`` pins, including EOS
 hit mid-block and mid-stream join/evict. Temperature sampling is
 supported but uses the engine's own per-block key schedule (a batched
 server cannot replay ``generate``'s per-request key walk).
+
+**Crash safety.** Donation makes a mid-dispatch exception nasty: the
+consumed ``kc``/``vc`` are already dead, so the engine cannot simply
+retry the block. Instead the host keeps enough state to rebuild from
+NOTHING — every slot retains its request's prompt, and host
+``generated`` is the committed truth. On any exception escaping
+``_dispatch_block`` / ``_admit``'s prefill / ``_drain_one``, the
+engine discards all in-flight blocks, reallocates the KV cache and
+device slot-state, and re-prefills each live slot from
+``prompt + generated`` — under greedy decoding the prefill over the
+full context emits exactly the token the lost decode step would have,
+so the replay is token-identical to a fault-free run (the contract
+``tests/test_serving_recovery.py`` pins, with faults injected via
+``edl_tpu.utils.faults``). Recovery attempts are bounded PER REQUEST
+(``max_recoveries``, default 2): a request that keeps sinking recovery
+passes finishes with outcome ``"failed"`` instead of wedging the
+engine. Requests carry optional deadlines (``deadline_s``): between
+blocks the engine evicts overdue slots (outcome ``"timeout"``) and
+sheds queued requests whose deadline passed while waiting
+(``rejected:timeout``) — overload drops the stalest work instead of
+growing the queue without bound.
 """
 
 from __future__ import annotations
@@ -83,7 +104,7 @@ from edl_tpu.serving.scheduler import (
     Request,
     RequestQueue,
 )
-from edl_tpu.utils import tracing
+from edl_tpu.utils import faults, tracing
 from edl_tpu.utils.logging import kv_logger
 
 log = kv_logger("serving")
@@ -171,21 +192,29 @@ def _prefill_program(cfg: llama.LlamaConfig, tb: int, sampling: bool):
 
 @dataclass
 class _Slot:
-    """Host-side state of one occupied KV slot (the device holds the
-    authoritative decode state; this is the bookkeeping mirror that
-    drained token matrices replay into)."""
+    """Host-side state of one occupied KV slot. The device holds the
+    authoritative decode state on the HOT path, but the host copy is
+    the RECOVERY truth: ``prompt`` + ``generated`` is everything needed
+    to re-prefill this slot into a freshly allocated cache after a
+    crash, and ``generated`` only ever contains drained (committed)
+    tokens. ``deadline`` is the absolute eviction time on the engine
+    clock (None = no deadline); ``recoveries`` counts how many engine
+    recovery passes this request has survived."""
 
     rid: str
+    prompt: List[int]
     max_new: int
     eos_id: Optional[int]
     generated: List[int] = field(default_factory=list)
+    deadline: Optional[float] = None
+    recoveries: int = 0
 
 
 @dataclass
 class RequestResult:
     rid: str
     tokens: List[int]
-    outcome: str  # done | eos
+    outcome: str  # done | eos | timeout | failed
 
 
 class ContinuousBatchingEngine:
@@ -224,6 +253,7 @@ class ContinuousBatchingEngine:
         temperature: float = 0.0,
         seed: int = 0,
         min_bucket: int = 8,
+        max_recoveries: int = 2,
         clock=time.monotonic,
     ):
         if max_slots < 1:
@@ -232,6 +262,10 @@ class ContinuousBatchingEngine:
             raise ValueError(f"horizon must be >= 1, got {horizon}")
         if temperature < 0:
             raise ValueError(f"temperature must be >= 0, got {temperature}")
+        if max_recoveries < 0:
+            raise ValueError(
+                f"max_recoveries must be >= 0, got {max_recoveries}"
+            )
         self.params = params
         self.cfg = cfg
         self.max_slots = max_slots
@@ -247,15 +281,42 @@ class ContinuousBatchingEngine:
         self.policy = policy or InterleavePolicy()
         self.temperature = float(temperature)
         self.min_bucket = min_bucket
+        self.max_recoveries = max_recoveries
+        self.recoveries = 0  # engine-total recovery passes
+        self.clock = clock
         self.results: Dict[str, RequestResult] = {}
         self._sampling = self.temperature > 0
         self._key = jax.random.PRNGKey(seed)
         self._slots: List[Optional[_Slot]] = [None] * max_slots
-        # device-side slot decode state: the block program's carry.
-        # The host NEVER syncs these on the hot path — it feeds the
-        # returned device arrays straight into the next dispatch and
-        # reconstructs its bookkeeping view from drained token
-        # matrices instead.
+        # request popped from the queue but not yet slotted — requeued
+        # at the head if the admission prefill faults
+        self._admitting: Optional[Request] = None
+        self._alloc_device_state()
+        self._decode = _block_program(
+            cfg, max_slots, max_len, horizon, self._sampling
+        )
+        L, kvh, hd = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+        cache_shape = (L, max_slots, max_len, kvh, hd)
+        log.info(
+            "engine ready",
+            slots=max_slots,
+            max_len=max_len,
+            horizon=horizon,
+            cache_mb=round(
+                2 * np.prod(cache_shape) * np.dtype(cfg.dtype).itemsize
+                / 2**20, 1),
+            sampling=self._sampling,
+        )
+
+    def _alloc_device_state(self) -> None:
+        """(Re)allocate the device-side slot decode state — the block
+        program's carry — plus the KV cache and the in-flight queue.
+        Called at construction AND by :meth:`_recover`, which rebuilds
+        the device world from the host's bookkeeping truth. The host
+        NEVER syncs these on the hot path — it feeds the returned
+        device arrays straight into the next dispatch and reconstructs
+        its bookkeeping view from drained token matrices instead."""
+        cfg, max_slots, max_len = self.cfg, self.max_slots, self.max_len
         self._dtok = jnp.zeros(max_slots, jnp.int32)
         self._dpos = jnp.zeros(max_slots, jnp.int32)
         self._dact = jnp.zeros(max_slots, bool)
@@ -273,18 +334,6 @@ class ContinuousBatchingEngine:
         # honors donation (CPU/TPU do; a backend that copies instead
         # just loses the in-place win, not correctness)
         self._donates: Optional[bool] = None
-        self._decode = _block_program(
-            cfg, max_slots, max_len, horizon, self._sampling
-        )
-        log.info(
-            "engine ready",
-            slots=max_slots,
-            max_len=max_len,
-            horizon=horizon,
-            cache_mb=round(2 * np.prod(shape) * np.dtype(cfg.dtype).itemsize
-                           / 2**20, 1),
-            sampling=self._sampling,
-        )
 
     # -- request intake -----------------------------------------------------
 
@@ -294,9 +343,12 @@ class ContinuousBatchingEngine:
         prompt: List[int],
         max_new: int,
         eos_id: Optional[int] = None,
+        deadline_s: Optional[float] = None,
     ) -> None:
         """Queue a request; raises :class:`AdmissionError` (and counts
-        the rejection) when admission control refuses it."""
+        the rejection) when admission control refuses it. ``deadline_s``
+        is a relative latency budget from now: past it the request is
+        shed from the queue or its slot evicted (outcome "timeout")."""
         self.metrics.on_submit(rid)
         if rid in self.results or any(
             s is not None and s.rid == rid for s in self._slots
@@ -310,10 +362,16 @@ class ContinuousBatchingEngine:
                 "bad_request",
                 f"{rid}: prompt tokens {bad[:4]} outside [0, {self.cfg.vocab})",
             )
+        if deadline_s is not None and deadline_s <= 0:
+            self.metrics.on_reject(rid, "bad_request")
+            raise AdmissionError(
+                "bad_request", f"{rid}: deadline_s must be > 0, got {deadline_s}"
+            )
         try:
             self.queue.submit(
                 Request(rid=rid, prompt=list(map(int, prompt)),
-                        max_new=int(max_new), eos_id=eos_id)
+                        max_new=int(max_new), eos_id=eos_id,
+                        deadline_s=deadline_s)
             )
         except AdmissionError as e:
             self.metrics.on_reject(rid, e.reason)
@@ -341,8 +399,22 @@ class ContinuousBatchingEngine:
         horizon block over every active slot, then drain the PREVIOUS
         block's token matrix while the new one runs on device. Returns
         tokens observed this iteration (prefill first-tokens included;
-        decode tokens surface at the drain of their block)."""
+        decode tokens surface at the drain of their block).
+
+        Any exception escaping the iteration (a device failure, an
+        injected fault) triggers :meth:`_recover` instead of
+        propagating: in-flight work is discarded, device state rebuilt,
+        and live requests replayed — the engine object stays usable and
+        no accepted request is silently lost."""
+        try:
+            return self._step_inner()
+        except Exception as e:
+            self._recover(e)
+            return 0
+
+    def _step_inner(self) -> int:
         emitted = 0
+        self._evict_overdue()
         if self.queue.depth > 0:
             if self._inflight and not any(s is None for s in self._slots):
                 # drain-to-admit: no slot is known-free, but an
@@ -368,6 +440,14 @@ class ContinuousBatchingEngine:
         while self.has_work and (max_steps is None or steps < max_steps):
             self.step()
             steps += 1
+        if self._inflight:
+            # a max_steps stop can land with blocks dispatched but
+            # undrained — tokens the device already produced would be
+            # missing from ``results``; sync them before returning
+            try:
+                self._drain_all()
+            except Exception as e:
+                self._recover(e)
         return dict(self.results)
 
     # -- internals ----------------------------------------------------------
@@ -420,6 +500,10 @@ class ContinuousBatchingEngine:
             )
         self.metrics.on_dispatch("decode")
         self._assert_donated(*old)
+        # chaos site: a crash HERE is the worst case — the donated
+        # inputs are dead, the carries are rebound, and the block's
+        # token matrix is about to be lost
+        faults.fault_point("serve.dispatch")
         self._inflight.append(toks)
 
     def _drain_one(self) -> int:
@@ -430,7 +514,11 @@ class ContinuousBatchingEngine:
         row at exactly the step the host would finish it, so the two
         views never disagree."""
         with tracing.span("serving.drain"):
-            out = np.asarray(self._inflight.popleft())
+            blk = self._inflight.popleft()
+            # chaos site: the popped block is lost on a crash here —
+            # its tokens exist only on device, recovery must regenerate
+            faults.fault_point("serve.drain")
+            out = np.asarray(blk)
         emitted = 0
         for i in range(self.max_slots):
             sl = self._slots[i]
@@ -469,6 +557,31 @@ class ContinuousBatchingEngine:
             b *= 2
         return min(b, self.max_len)
 
+    def _evict_overdue(self) -> None:
+        """Deadline enforcement between blocks: a live slot past its
+        absolute deadline finishes NOW with what it has (outcome
+        "timeout"). Bookkeeping-only like every eviction — the device
+        row keeps decoding until the slot is reused, drains skip it."""
+        now = self.clock()
+        for i, sl in enumerate(self._slots):
+            if sl is not None and sl.deadline is not None and now > sl.deadline:
+                self._finish(i, "timeout")
+
+    def _shed_expired(self, req: Request) -> bool:
+        """Queue-side load shedding: a popped request whose deadline
+        passed while it waited is finished as ``rejected:timeout``
+        without ever touching the device — an overloaded engine drops
+        the stalest work instead of prefilling tokens nobody will
+        consume."""
+        dl = req.deadline_at()
+        if dl is None or self.clock() <= dl:
+            return False
+        self.metrics.on_reject(req.rid, "timeout")
+        self.results[req.rid] = RequestResult(
+            rid=req.rid, tokens=[], outcome="timeout"
+        )
+        return True
+
     def _admit(self) -> int:
         free = [i for i, s in enumerate(self._slots) if s is None]
         budget = self.policy.block_budget(
@@ -479,41 +592,25 @@ class ContinuousBatchingEngine:
             req = self.queue.pop()
             if req is None:
                 break
+            if self._shed_expired(req):
+                continue
             slot = free.pop(0)
-            t0 = len(req.prompt)
-            tb = self._bucket(t0)
-            toks = np.zeros((1, tb), np.int32)
-            toks[0, :t0] = req.prompt
-            prefill = _prefill_program(self.cfg, tb, self._sampling)
-            old = (self._dtok, self._dpos, self._dact, self._drem,
-                   self._deos, self._kc, self._vc)
-            with tracing.span("serving.prefill", bucket=tb):
-                (tok0, self._dtok, self._dpos, self._dact, self._drem,
-                 self._deos, self._kc, self._vc) = prefill(
-                    self.params,
-                    jnp.asarray(toks),
-                    jnp.int32(t0 - 1),
-                    jnp.int32(slot),
-                    jnp.int32(req.max_new),
-                    jnp.int32(-1 if req.eos_id is None else req.eos_id),
-                    old[0], old[1], old[2], old[3], old[4], old[5], old[6],
-                    self._next_key(),
-                    self._temp(),
-                )
-                self.metrics.on_dispatch("prefill")
-                self._assert_donated(*old)
-                # admission is a sync point by design: the first token
-                # IS the TTFT sample, so it must be observed now, not a
-                # block later (and any block dispatched before this
-                # admission completed on device as a dependency of the
-                # prefill)
-                tok0 = int(np.asarray(tok0))
-            self.metrics.on_admit(req.rid, t0)
+            # from here to the bookkeeping commit the request exists
+            # only in this local — publish it so a prefill crash
+            # requeues it at the head instead of losing it
+            self._admitting = req
+            tok0 = self._prefill_into(
+                slot, req.prompt, req.max_new, req.eos_id,
+                site="serve.prefill",
+            )
+            self.metrics.on_admit(req.rid, len(req.prompt))
             sl = _Slot(
-                rid=req.rid, max_new=req.max_new,
+                rid=req.rid, prompt=list(req.prompt), max_new=req.max_new,
                 eos_id=req.eos_id, generated=[tok0],
+                deadline=req.deadline_at(),
             )
             self._slots[slot] = sl
+            self._admitting = None
             self.metrics.on_token(req.rid)
             emitted += 1
             if sl.eos_id is not None and tok0 == sl.eos_id:
@@ -521,6 +618,55 @@ class ContinuousBatchingEngine:
             elif sl.max_new <= 1:
                 self._finish(slot, "done")
         return emitted
+
+    def _prefill_into(
+        self,
+        slot: int,
+        seq: List[int],
+        max_new: int,
+        eos_id: Optional[int],
+        site: Optional[str] = None,
+    ) -> int:
+        """One prefill-insert dispatch: run ``seq`` through the bucketed
+        prefill program, scatter its K/V into cache row ``slot``, reset
+        the row's device decode state to a ``max_new``-token budget, and
+        return the first sampled token. Shared by admission (``seq`` =
+        the prompt) and crash recovery (``seq`` = prompt + generated —
+        greedy argmax over the full context emits exactly the token the
+        lost decode step would have)."""
+        t0 = len(seq)
+        tb = self._bucket(t0)
+        toks = np.zeros((1, tb), np.int32)
+        toks[0, :t0] = seq
+        prefill = _prefill_program(self.cfg, tb, self._sampling)
+        old = (self._dtok, self._dpos, self._dact, self._drem,
+               self._deos, self._kc, self._vc)
+        with tracing.span("serving.prefill", bucket=tb):
+            (tok0, self._dtok, self._dpos, self._dact, self._drem,
+             self._deos, self._kc, self._vc) = prefill(
+                self.params,
+                jnp.asarray(toks),
+                jnp.int32(t0 - 1),
+                jnp.int32(slot),
+                jnp.int32(max_new),
+                jnp.int32(-1 if eos_id is None else eos_id),
+                old[0], old[1], old[2], old[3], old[4], old[5], old[6],
+                self._next_key(),
+                self._temp(),
+            )
+            self.metrics.on_dispatch("prefill")
+            self._assert_donated(*old)
+            if site is not None:
+                # chaos site (admission only — recovery replays are
+                # not re-faulted at the same site, the dispatch sites
+                # cover post-recovery failures)
+                faults.fault_point(site)
+            # admission is a sync point by design: the first token
+            # IS the TTFT sample, so it must be observed now, not a
+            # block later (and any block dispatched before this
+            # admission completed on device as a dependency of the
+            # prefill)
+            return int(np.asarray(tok0))
 
     def _finish(self, slot: int, outcome: str) -> None:
         sl = self._slots[slot]
@@ -533,3 +679,89 @@ class ContinuousBatchingEngine:
         # the next prefill-insert overwrites it, and the block program
         # never changes shape
         self._slots[slot] = None
+
+    # -- crash recovery ------------------------------------------------------
+
+    def _recover(self, err: Exception) -> None:
+        """Rebuild the engine from host truth after an exception escaped
+        a dispatch/prefill/drain. The device world (donated caches,
+        slot-state carries, in-flight token matrices) is assumed GONE —
+        some of it genuinely is: donated inputs are dead and undrained
+        blocks hold tokens the host never saw. What survives is exactly
+        what each slot retains: ``prompt + generated`` (only drained
+        tokens ever enter ``generated``). Recovery:
+
+        1. requeue a request caught mid-admission (popped, not slotted)
+           at the queue HEAD — it keeps its FIFO position;
+        2. charge every live slot one recovery attempt; requests past
+           ``max_recoveries`` finish with outcome "failed" (bounded
+           recovery — a poisoned request cannot wedge the engine);
+        3. drop in-flight blocks, reallocate the KV cache and device
+           slot-state from zeros;
+        4. re-prefill each surviving slot from ``prompt + generated``
+           with its REMAINING budget — under greedy decoding the full-
+           context prefill emits exactly the token the lost decode step
+           would have, so post-recovery output is token-identical to a
+           fault-free run (the tests/test_serving_recovery.py contract;
+           temperature sampling recovers too, but the key schedule
+           shifts, so sampled continuations may differ).
+
+        A fault DURING recovery recurses (step 2's per-request bound
+        makes the recursion terminate: every pass either finishes a
+        request or burns one of its bounded attempts)."""
+        log.warn(
+            "engine fault; recovering",
+            error=f"{type(err).__name__}: {err}",
+            inflight=len(self._inflight),
+            live=self.active_slots,
+        )
+        with tracing.span("serving.recover"):
+            if self._admitting is not None:
+                # the mid-admission request is charged like a slotted
+                # one — otherwise a request whose prefill always faults
+                # would requeue forever, never burning its budget
+                req = self._admitting
+                self._admitting = None
+                req.recoveries += 1
+                if req.recoveries > self.max_recoveries:
+                    self.results[req.rid] = RequestResult(
+                        rid=req.rid, tokens=[], outcome="failed"
+                    )
+                    self.metrics.on_finish(req.rid, "failed")
+                else:
+                    self.queue.requeue_front(req)
+            live = []
+            for i, sl in enumerate(self._slots):
+                if sl is None:
+                    continue
+                sl.recoveries += 1
+                if sl.recoveries > self.max_recoveries:
+                    self._finish(i, "failed")
+                else:
+                    live.append(i)
+            self.recoveries += 1
+            self.metrics.on_recovery(len(live))
+            self._alloc_device_state()
+            for i in live:
+                try:
+                    self._replay_slot(i)
+                except Exception as e2:
+                    self._recover(e2)
+                    return
+
+    def _replay_slot(self, slot: int) -> None:
+        """Re-prefill one live slot from ``prompt + generated``: the
+        prefill emits the NEXT token (appended like any generated
+        token), rebuilds the row's K/V, and resets its device budget to
+        the tokens still owed. EOS/budget termination is re-checked on
+        the emitted token exactly like admission."""
+        sl = self._slots[slot]
+        seq = sl.prompt + sl.generated
+        remaining = sl.max_new - len(sl.generated)
+        tok = self._prefill_into(slot, seq, remaining, sl.eos_id)
+        sl.generated.append(tok)
+        self.metrics.on_token(sl.rid)
+        if sl.eos_id is not None and tok == sl.eos_id:
+            self._finish(slot, "eos")
+        elif len(sl.generated) >= sl.max_new:
+            self._finish(slot, "done")

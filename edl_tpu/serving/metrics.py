@@ -78,8 +78,9 @@ class ServingMetrics:
         self.admitted = 0
         self.completed = 0
         self.tokens_out = 0
+        self.recoveries = 0  # engine crash-recovery passes
         self.rejected: Counter = Counter()  # reason -> n
-        self.outcomes: Counter = Counter()  # done/eos -> n
+        self.outcomes: Counter = Counter()  # done/eos/timeout/failed -> n
         self.dispatches: Counter = Counter()  # decode/prefill -> n
         self.requests: Dict[str, _ReqRecord] = {}
         self._steps = 0
@@ -96,6 +97,11 @@ class ServingMetrics:
         self._m_tokens = r.counter("edl_serving_tokens_total", "generated tokens")
         self._m_dispatch = r.counter(
             "edl_serving_dispatch_total", "device program dispatches", ("kind",)
+        )
+        self._m_recoveries = r.counter(
+            "edl_serving_recoveries_total",
+            "engine crash-recovery passes (device state rebuilt, live "
+            "slots re-prefilled from prompt + generated)",
         )
         # per-ENGINE histograms back the snapshot percentiles (several
         # engines may share the process registry; their union belongs
@@ -187,6 +193,12 @@ class ServingMetrics:
         self.dispatches[kind] += 1
         self._m_dispatch.inc(kind=kind)
 
+    def on_recovery(self, live_slots: int) -> None:
+        """One engine recovery pass: in-flight blocks discarded, device
+        state rebuilt, ``live_slots`` requests replayed in place."""
+        self.recoveries += 1
+        self._m_recoveries.inc()
+
     def on_finish(self, rid: str, outcome: str) -> None:
         self.completed += 1
         self.outcomes[outcome] += 1
@@ -244,6 +256,7 @@ class ServingMetrics:
             "admitted": float(self.admitted),
             "rejected": float(sum(self.rejected.values())),
             "completed": float(self.completed),
+            "recoveries": float(self.recoveries),
             "tokens_out": float(self.tokens_out),
             "queue_depth": float(self._queue_depth),
             "active_slots": float(self._active_now),
@@ -281,4 +294,6 @@ class ServingMetrics:
         }
         for reason, n in sorted(self.rejected.items()):
             snap[f"rejected_{reason}"] = float(n)
+        for outcome, n in sorted(self.outcomes.items()):
+            snap[f"outcome_{outcome}"] = float(n)
         return snap

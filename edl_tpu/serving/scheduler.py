@@ -26,13 +26,25 @@ from typing import Deque, List, Optional
 class Request:
     """One generation request: prompt token ids plus its decode budget.
     ``eos_id`` stops decode early when emitted (the EOS token is
-    included in the output, outcome "eos")."""
+    included in the output, outcome "eos"). ``deadline_s`` is a RELATIVE
+    latency budget from submit: past it, the engine sheds the request
+    from the queue (``rejected:timeout``) or evicts its slot between
+    blocks (outcome "timeout") — overload degrades by dropping the
+    stalest work, never by growing the queue without bound."""
 
     rid: str
     prompt: List[int]
     max_new: int
     eos_id: Optional[int] = None
+    deadline_s: Optional[float] = None
     submit_s: float = 0.0  # stamped by the queue at admission
+    recoveries: int = 0  # engine crash-recovery passes charged while queued
+
+    def deadline_at(self) -> Optional[float]:
+        """Absolute deadline on the queue's clock, or None."""
+        if self.deadline_s is None:
+            return None
+        return self.submit_s + self.deadline_s
 
 
 class AdmissionError(ValueError):
@@ -113,6 +125,13 @@ class RequestQueue:
     def pop(self) -> Optional[Request]:
         """Next request for prefill (FIFO), or None when empty."""
         return self._q.popleft() if self._q else None
+
+    def requeue_front(self, req: Request) -> None:
+        """Put an already-admitted request back at the HEAD of the
+        queue (crash recovery: a request popped for prefill when the
+        engine faulted keeps its FIFO position — no re-validation, it
+        already passed admission)."""
+        self._q.appendleft(req)
 
 
 @dataclass(frozen=True)

@@ -50,6 +50,17 @@ JAX_PLATFORMS=cpu python scripts/exp_serving.py --dryrun --metrics-port 0
 rc4=$?
 t4=$(date +%s)
 echo "== phase 4 done in $((t4 - t3))s (rc=$rc4) =="
-echo "== total $((t4 - t0))s =="
 
-[ "$rc1" -eq 0 ] && [ "$rc2" -eq 0 ] && [ "$rc3" -eq 0 ] && [ "$rc4" -eq 0 ]
+echo "== phase 5: deterministic chaos lane (exp_chaos --dryrun) =="
+# fixed-seed fault plans through the REAL fault points: hard-asserts
+# greedy token identity vs the fault-free serving run (incl. requests
+# mid-stream at the injected crash), bounded recovery counts, training
+# reaching the same step/loss under 5% coordinator RPC drops, and that
+# every armed fault actually fired
+JAX_PLATFORMS=cpu python scripts/exp_chaos.py --dryrun --seed 0
+rc5=$?
+t5=$(date +%s)
+echo "== phase 5 done in $((t5 - t4))s (rc=$rc5) =="
+echo "== total $((t5 - t0))s =="
+
+[ "$rc1" -eq 0 ] && [ "$rc2" -eq 0 ] && [ "$rc3" -eq 0 ] && [ "$rc4" -eq 0 ] && [ "$rc5" -eq 0 ]

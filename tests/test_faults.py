@@ -1,0 +1,192 @@
+"""Deterministic fault injection (edl_tpu/utils/faults.py): plan
+grammar, trigger semantics, seeded determinism, actions, env/JSON
+arming, and the injection counter. jax-free."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.utils import faults
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+# -- grammar -----------------------------------------------------------------
+
+
+def test_parse_plan_grammar():
+    specs = faults.parse_plan(
+        "serve.dispatch:raise@n=3;coord.rpc:drop@p=0.05;"
+        "metrics.push:delay@every=2,s=0.25,max=4"
+    )
+    assert [s.site for s in specs] == [
+        "serve.dispatch", "coord.rpc", "metrics.push"
+    ]
+    assert specs[0].action == "raise" and specs[0].n == 3
+    assert specs[1].action == "drop" and specs[1].p == 0.05
+    assert specs[2].action == "delay"
+    assert specs[2].every == 2 and specs[2].delay_s == 0.25 and specs[2].max == 4
+
+
+@pytest.mark.parametrize("bad", [
+    "",                          # empty plan
+    "site-without-action",       # no action
+    "s:explode@n=1",             # unknown action
+    "s:raise@n=1,every=2",       # two triggers
+    "s:raise",                   # no trigger
+    "s:raise@p=1.5",             # p out of range
+    "s:raise@bogus=1",           # unknown param
+])
+def test_parse_plan_rejects(bad):
+    with pytest.raises(ValueError):
+        faults.parse_plan(bad)
+
+
+# -- triggers ----------------------------------------------------------------
+
+
+def _fires(site, calls):
+    out = []
+    for _ in range(calls):
+        try:
+            faults.fault_point(site)
+            out.append(False)
+        except (faults.InjectedFault, faults.InjectedConnectionError):
+            out.append(True)
+    return out
+
+
+def test_nth_call_fires_exactly_once():
+    faults.arm("s:raise@n=3")
+    assert _fires("s", 6) == [False, False, True, False, False, False]
+    assert faults.counts() == {"s": 1}
+
+
+def test_every_k_with_max_cap():
+    faults.arm("s:raise@every=2,max=2")
+    assert _fires("s", 8) == [False, True, False, True, False, False,
+                              False, False]
+    assert faults.counts() == {"s": 2}
+
+
+def test_probability_deterministic_given_seed():
+    runs = []
+    for _ in range(2):
+        faults.arm("s:raise@p=0.3", seed=7)
+        runs.append(_fires("s", 40))
+    assert runs[0] == runs[1]
+    assert any(runs[0]) and not all(runs[0])
+    faults.arm("s:raise@p=0.3", seed=8)
+    assert _fires("s", 40) != runs[0]  # a different seed, different walk
+
+
+def test_sites_are_independent_streams():
+    """Per-site PRNGs: interleaving calls to another site must not
+    perturb a site's firing pattern (determinism survives concurrency
+    reordering across sites)."""
+    faults.arm("a:raise@p=0.5;b:raise@p=0.5", seed=3)
+    solo = _fires("a", 20)
+    faults.arm("a:raise@p=0.5;b:raise@p=0.5", seed=3)
+    interleaved = []
+    for _ in range(20):
+        _fires("b", 1)
+        interleaved.extend(_fires("a", 1))
+    assert interleaved == solo
+
+
+def test_arm_resets_counters():
+    faults.arm("s:raise@n=1")
+    assert _fires("s", 1) == [True]
+    faults.arm("s:raise@n=1")  # re-arm: the nth-call counter restarts
+    assert _fires("s", 1) == [True]
+
+
+# -- actions -----------------------------------------------------------------
+
+
+def test_drop_raises_connection_error():
+    faults.arm("rpc:drop@n=1")
+    with pytest.raises(ConnectionError) as e:
+        faults.fault_point("rpc")
+    assert isinstance(e.value, faults.InjectedConnectionError)
+    assert e.value.site == "rpc"
+
+
+def test_delay_sleeps():
+    faults.arm("slow:delay@n=1,s=0.1")
+    t0 = time.perf_counter()
+    faults.fault_point("slow")  # injected delay, no raise
+    assert time.perf_counter() - t0 >= 0.1
+    t0 = time.perf_counter()
+    faults.fault_point("slow")  # n=1 passed: no-op again
+    assert time.perf_counter() - t0 < 0.05
+
+
+def test_unarmed_is_noop_and_cheap():
+    assert not faults.armed()
+    for _ in range(1000):
+        faults.fault_point("anything")  # must never raise
+    assert faults.counts() == {}
+
+
+# -- observability -----------------------------------------------------------
+
+
+def test_injections_counted_in_registry():
+    reg = obs_metrics.reset_default_registry()
+    faults.arm("x:raise@every=1,max=3")
+    _fires("x", 5)
+    c = reg.get("edl_faults_injected_total")
+    assert c is not None and c.value(site="x") == 3
+
+
+# -- env / JSON arming -------------------------------------------------------
+
+
+def test_env_arming_inline_and_json(tmp_path):
+    code = (
+        "from edl_tpu.utils import faults\n"
+        "assert faults.armed()\n"
+        "import pytest, sys\n"
+        "try:\n"
+        "    faults.fault_point('serve.dispatch')\n"
+        "except faults.InjectedFault:\n"
+        "    sys.exit(0)\n"
+        "sys.exit(1)\n"
+    )
+    env = {**os.environ, "PYTHONPATH": os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))}
+    r = subprocess.run(
+        [sys.executable, "-c", code],
+        env={**env, "EDL_FAULTS": "serve.dispatch:raise@n=1"},
+    )
+    assert r.returncode == 0
+
+    doc = {"seed": 5, "faults": [
+        {"site": "serve.dispatch", "action": "raise", "n": 1}
+    ]}
+    plan_file = tmp_path / "plan.json"
+    plan_file.write_text(json.dumps(doc))
+    r = subprocess.run(
+        [sys.executable, "-c", code],
+        env={**env, "EDL_FAULTS": str(plan_file)},
+    )
+    assert r.returncode == 0
+
+
+def test_json_plan_arm_direct():
+    specs = faults.arm({"seed": 2, "faults": [
+        {"site": "a", "action": "drop", "p": 1.0, "max": 1},
+    ]})
+    assert len(specs) == 1 and specs[0].p == 1.0
+    assert _fires("a", 3) == [True, False, False]

@@ -1,0 +1,277 @@
+"""Robustness satellites (ISSUE 4): heartbeat thread survives
+coordinator loss with a degraded gauge, p2p restore times out instead
+of hanging when the decision never arrives, MetricsPusher backs off
+with jitter, and injected RPC drops ride the real reconnect path."""
+
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.runtime.coordinator import PyCoordinator, ensure_native_built
+from edl_tpu.utils import faults
+
+HAVE_NATIVE = ensure_native_built()
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+# -- worker heartbeat degradation -------------------------------------------
+
+
+def _bare_worker():
+    """An ElasticWorker shell with just the state _beat_tick touches —
+    the real __init__ dials a coordinator, which these tests replace."""
+    from edl_tpu.runtime.worker_main import ElasticWorker
+
+    w = object.__new__(ElasticWorker)
+    w.cfg = SimpleNamespace(
+        coord_host="127.0.0.1", coord_port=1, worker_id="w0",
+        member_ttl_s=2.0,
+    )
+    w._leaving = False
+    w._hb_degraded = False
+    return w
+
+
+def test_heartbeat_tick_survives_dead_coordinator():
+    """A ConnectionError (reconnect window exhausted / nothing
+    listening) must NOT propagate out of the beat tick: the worker
+    flips the degraded flag + gauge and keeps retrying — previously the
+    thread died and the worker silently TTL-expired while training."""
+    reg = obs_metrics.reset_default_registry()
+    w = _bare_worker()  # port 1: nothing listens
+    for _ in range(3):  # repeated ticks keep retrying, never raise
+        assert w._beat_tick(None, incarnation=1) is None
+    assert w._hb_degraded
+    g = reg.get("edl_worker_heartbeat_degraded")
+    assert g is not None and g.value() == 1
+
+
+def test_heartbeat_tick_recovers_and_clears_gauge():
+    reg = obs_metrics.reset_default_registry()
+    w = _bare_worker()
+    assert w._beat_tick(None, incarnation=1) is None  # outage
+    assert w._hb_degraded
+
+    class FakeClient:
+        def __init__(self):
+            self.beats = 0
+
+        def heartbeat(self, wid):
+            self.beats += 1
+            return True
+
+        def close(self):
+            pass
+
+    c = FakeClient()
+    assert w._beat_tick(c, incarnation=1) is c  # coordinator back
+    assert c.beats == 1
+    assert not w._hb_degraded
+    assert reg.get("edl_worker_heartbeat_degraded").value() == 0
+
+
+def test_heartbeat_tick_reregisters_after_ttl_eviction():
+    w = _bare_worker()
+
+    class EvictedClient:
+        def __init__(self):
+            self.registered = []
+
+        def heartbeat(self, wid):
+            return False  # TTL already evicted us
+
+        def register(self, wid, inc):
+            self.registered.append((wid, inc))
+            return 7
+
+        def close(self):
+            pass
+
+    c = EvictedClient()
+    assert w._beat_tick(c, incarnation=4) is c
+    assert c.registered == [("w0", 4)]
+
+
+# -- p2p restore: no decision must raise, not hang ---------------------------
+
+
+def _plane(cl, timeout_s):
+    from edl_tpu.runtime.epoch_gc import EpochKeyGC
+    from edl_tpu.runtime.p2p_restore import P2PRestorePlane
+
+    cfg = SimpleNamespace(
+        job="j", worker_id="w1", p2p=True, rendezvous_timeout_s=timeout_s,
+        p2p_linger_s=0.0,
+    )
+    return P2PRestorePlane(
+        cfg, lambda *p: "/".join(("j",) + p), EpochKeyGC(), lambda: None
+    )
+
+
+def test_p2p_restore_times_out_when_rank0_never_decides():
+    """Rank 0 is ALIVE but never publishes the restore decision (e.g.
+    wedged probing peers): a non-leader must raise TimeoutError within
+    rendezvous_timeout_s — never hang the epoch."""
+    cl = PyCoordinator(member_ttl_s=30.0)
+    cl.register("w0", 1)
+    cl.register("w1", 1)
+    members = cl.members()
+    assert members[0].rank == 0 and members[0].name == "w0"
+    plane = _plane(cl, timeout_s=0.4)
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError, match="no restore decision"):
+        plane.restore(
+            cl, cl.epoch(), rank=1, members=members, like=None,
+            state_sh=None, manifest=None, ram_snapshot=None,
+        )
+    elapsed = time.monotonic() - t0
+    assert 0.3 <= elapsed < 5.0, elapsed
+
+
+def test_p2p_restore_bails_fast_when_rank0_dead():
+    """A DEAD rank 0 can never publish: the waiter must bail with
+    RuntimeError immediately, not burn the rendezvous timeout."""
+    cl = PyCoordinator(member_ttl_s=30.0)
+    cl.register("w0", 1)
+    cl.register("w1", 1)
+    members = cl.members()
+    epoch = cl.epoch()
+    cl.leave("w0")  # rank 0 dies after rendezvous
+    plane = _plane(cl, timeout_s=30.0)
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError, match="rank-0 worker died"):
+        plane.restore(
+            cl, epoch, rank=1, members=members, like=None,
+            state_sh=None, manifest=None, ram_snapshot=None,
+        )
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_p2p_restore_bails_on_epoch_move():
+    cl = PyCoordinator(member_ttl_s=30.0)
+    cl.register("w0", 1)
+    cl.register("w1", 1)
+    members = cl.members()
+    epoch = cl.epoch()
+    cl.register("w2", 1)  # membership moves: the group is regrouping
+    plane = _plane(cl, timeout_s=30.0)
+    with pytest.raises(RuntimeError, match="membership moved"):
+        plane.restore(
+            cl, epoch, rank=1, members=members, like=None,
+            state_sh=None, manifest=None, ram_snapshot=None,
+        )
+
+
+# -- MetricsPusher backoff ---------------------------------------------------
+
+
+def test_pusher_backoff_grows_and_resets():
+    from edl_tpu.obs.fleet import MetricsPusher
+
+    reg = obs_metrics.reset_default_registry()
+    fail = {"on": True}
+
+    def publish(payload):
+        if fail["on"]:
+            raise ConnectionError("outage")
+
+    p = MetricsPusher(publish, interval_s=1.0, backoff_cap_s=64.0)
+    assert p.next_wait_s() == 1.0  # healthy: the fixed interval
+    waits = []
+    for _ in range(4):
+        assert not p.push_once()
+        waits.append(p.next_wait_s())
+    # exponential with ±50% jitter: streak k waits in [2^k/2, 1.5*2^k];
+    # adjacent streaks may overlap, two apart may not
+    for k, w in enumerate(waits, start=1):
+        assert 0.5 * 2**k <= w <= 1.5 * 2**k, (k, w)
+    assert waits[2] > waits[0] and waits[3] > waits[1]
+    c = reg.get("edl_metrics_push_failures_total")
+    assert c is not None and c.value() == 4
+    fail["on"] = False
+    assert p.push_once()  # success resets the streak...
+    assert p.next_wait_s() == 1.0  # ...back to full rate
+    assert c.value() == 4
+
+
+def test_pusher_backoff_respects_cap():
+    from edl_tpu.obs.fleet import MetricsPusher
+
+    obs_metrics.reset_default_registry()
+    p = MetricsPusher(
+        lambda s: (_ for _ in ()).throw(OSError("down")),
+        interval_s=1.0, backoff_cap_s=8.0,
+    )
+    for _ in range(20):
+        p.push_once()
+    assert p.next_wait_s() <= 1.5 * 8.0
+
+
+def test_pusher_failure_site_injectable():
+    """The metrics.push fault point drives the REAL failure path: the
+    counter increments and backoff engages without a broken network."""
+    from edl_tpu.obs.fleet import MetricsPusher
+
+    obs_metrics.reset_default_registry()
+    got = []
+    p = MetricsPusher(got.append, interval_s=1.0)
+    faults.arm("metrics.push:raise@n=1")
+    assert not p.push_once()  # injected
+    assert p.next_wait_s() != 1.0
+    assert p.push_once()  # next tick succeeds, snapshot delivered
+    assert len(got) == 1 and p.next_wait_s() == 1.0
+
+
+# -- injected RPC drops ride the real reconnect path -------------------------
+
+
+@pytest.mark.skipif(not HAVE_NATIVE, reason="no C++ toolchain")
+def test_client_survives_injected_rpc_drops():
+    """coord.rpc:drop raises ConnectionError INSIDE _call: the client
+    must close, re-dial, and re-issue transparently — every op
+    succeeds despite a 30% drop rate, and the reconnect counter shows
+    the path actually ran."""
+    from edl_tpu.runtime.coordinator import CoordinatorServer
+
+    reg = obs_metrics.reset_default_registry()
+    with CoordinatorServer(member_ttl_s=5.0) as srv:
+        c = srv.client()
+        faults.arm("coord.rpc:drop@p=0.3", seed=1)
+        for i in range(30):
+            c.kv_put(f"k{i}", str(i))
+        for i in range(30):
+            assert c.kv_get(f"k{i}") == str(i)
+        fired = faults.counts()["coord.rpc"]
+        faults.disarm()
+        c.close()
+    assert fired > 0
+    rec = reg.get("edl_coordinator_reconnects_total")
+    assert rec is not None and rec.value() >= fired
+
+
+# -- the chaos harness CLI lane (slow) ---------------------------------------
+
+
+@pytest.mark.slow
+def test_exp_chaos_dryrun():
+    import os
+    import subprocess
+    import sys
+
+    out = subprocess.run(
+        [sys.executable, "scripts/exp_chaos.py", "--dryrun"],
+        capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "serving lane OK" in out.stdout
+    assert "chaos soak OK" in out.stdout

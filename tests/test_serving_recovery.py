@@ -1,0 +1,232 @@
+"""Crash-safe serving: the engine rebuilds from host truth after any
+exception escaping dispatch/prefill/drain, and the replay is
+token-identical under greedy decoding.
+
+The contract (ISSUE 4): slots retain ``prompt``; on a fault the engine
+discards in-flight blocks, reallocates the KV cache + device slot
+state, and re-prefills each live slot from ``prompt + generated`` —
+greedy argmax over the full context emits exactly the token the lost
+decode step would have. Recovery is bounded per request
+(``max_recoveries``), overdue work is shed (deadlines), and every
+recovery is counted. Faults are injected deterministically through
+``edl_tpu.utils.faults`` at the engine's REAL fault points.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from edl_tpu.models import llama
+from edl_tpu.serving.engine import ContinuousBatchingEngine
+from edl_tpu.utils import faults
+
+CFG = llama.LlamaConfig.tiny()
+PARAMS = llama.init_params(jax.random.PRNGKey(0), CFG)
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+def _sequential(prompt, max_new):
+    toks = llama.generate(
+        PARAMS, jnp.asarray([prompt], jnp.int32), CFG, max_new=max_new
+    )
+    return [int(t) for t in np.asarray(toks)[0]]
+
+
+PROMPTS = [list(range(2, 2 + n)) for n in (4, 7, 3, 9, 5, 6)]
+MAX_NEWS = [6, 3, 13, 5, 7, 9]
+
+
+def _run_mixed(horizon=4, max_recoveries=2, plan=None, seed=0):
+    """The mid-stream workload: 3 requests in, one block dispatched,
+    3 more join — so a crash lands with requests at different depths."""
+    if plan:
+        faults.arm(plan, seed=seed)
+    eng = ContinuousBatchingEngine(
+        PARAMS, CFG, max_slots=3, max_len=64, horizon=horizon,
+        max_recoveries=max_recoveries,
+    )
+    for i in range(3):
+        eng.submit(f"r{i}", PROMPTS[i], MAX_NEWS[i])
+    eng.step()  # first block in flight
+    for i in range(3, 6):
+        eng.submit(f"r{i}", PROMPTS[i], MAX_NEWS[i])
+    res = eng.run()
+    faults.disarm()
+    return eng, res
+
+
+def test_dispatch_fault_token_identity():
+    """The acceptance contract: with ``serve.dispatch:raise@n=3`` armed
+    the greedy output of EVERY request — including those mid-stream at
+    the crash — is token-identical to the fault-free run, and the
+    recovery count respects the bound."""
+    eng, res = _run_mixed(plan="serve.dispatch:raise@n=3")
+    assert set(res) == {f"r{i}" for i in range(6)}
+    for i in range(6):
+        assert res[f"r{i}"].tokens == _sequential(PROMPTS[i], MAX_NEWS[i]), (
+            f"r{i} diverged after crash recovery"
+        )
+        assert res[f"r{i}"].outcome in ("done", "eos")
+    assert 1 <= eng.recoveries <= eng.max_recoveries
+    assert all(
+        (sl is None or sl.recoveries <= eng.max_recoveries)
+        for sl in eng._slots
+    )
+    snap = eng.metrics.snapshot()
+    assert snap["recoveries"] == eng.recoveries
+    assert snap["completed"] == 6
+
+
+@pytest.mark.parametrize("plan", [
+    "serve.drain:raise@n=2",        # a device-complete block is lost
+    "serve.prefill:raise@n=2",      # crash mid-admission: requeue at head
+    "serve.dispatch:raise@n=2;serve.drain:raise@n=5",  # combined
+])
+def test_fault_sites_token_identity(plan):
+    eng, res = _run_mixed(horizon=8, max_recoveries=3, plan=plan)
+    for i in range(6):
+        assert res[f"r{i}"].tokens == _sequential(PROMPTS[i], MAX_NEWS[i]), (
+            f"r{i} under {plan}"
+        )
+    assert eng.recoveries >= 1
+
+
+def test_recovery_at_every_horizon():
+    """The replay contract holds at H=1 (per-token) and deep horizons
+    alike — the lost-block size changes, the output must not."""
+    for h in (1, 4, 16):
+        _, res = _run_mixed(horizon=h, plan="serve.dispatch:raise@n=2")
+        for i in range(6):
+            assert res[f"r{i}"].tokens == _sequential(
+                PROMPTS[i], MAX_NEWS[i]
+            ), f"r{i} at horizon {h}"
+
+
+def test_bounded_recovery_failed_outcome_and_engine_survives():
+    """A poisoned path (every dispatch faults) cannot wedge the engine:
+    each request burns its ``max_recoveries`` and finishes "failed";
+    once the fault clears, the SAME engine serves new work correctly."""
+    faults.arm("serve.dispatch:raise@every=1", seed=0)
+    eng = ContinuousBatchingEngine(
+        PARAMS, CFG, max_slots=2, max_len=64, max_recoveries=2
+    )
+    eng.submit("doomed", [1, 2, 3], 8)
+    res = eng.run()
+    faults.disarm()
+    assert res["doomed"].outcome == "failed"
+    # partial progress was preserved: each recovery replays one token
+    assert 0 < len(res["doomed"].tokens) < 8
+    assert eng.recoveries == eng.max_recoveries + 1
+    assert eng.metrics.outcomes["failed"] == 1
+    # the engine object is still healthy post-chaos
+    eng.submit("fresh", [4, 5, 6], 5)
+    res = eng.run()
+    assert res["fresh"].tokens == _sequential([4, 5, 6], 5)
+    assert res["fresh"].outcome == "done"
+
+
+def test_prefill_fault_preserves_fifo_and_request():
+    """A crash mid-admission requeues the popped request at the queue
+    HEAD: nothing is lost and it still completes token-identically."""
+    faults.arm("serve.prefill:raise@n=1", seed=0)
+    eng = ContinuousBatchingEngine(PARAMS, CFG, max_slots=1, max_len=64)
+    eng.submit("first", [1, 2, 3, 4], 5)
+    eng.submit("second", [5, 6, 7], 4)
+    res = eng.run()
+    faults.disarm()
+    assert res["first"].tokens == _sequential([1, 2, 3, 4], 5)
+    assert res["second"].tokens == _sequential([5, 6, 7], 4)
+    # FIFO survived the crash: "first" finished before "second" started
+    m = eng.metrics.requests
+    assert m["first"].finish_s <= m["second"].admit_s
+
+
+def test_recovery_counter_in_registry():
+    from edl_tpu.obs import metrics as obs_metrics
+
+    reg = obs_metrics.reset_default_registry()
+    _run_mixed(plan="serve.dispatch:raise@n=2")
+    c = reg.get("edl_serving_recoveries_total")
+    assert c is not None and c.value() >= 1
+    f = reg.get("edl_faults_injected_total")
+    assert f is not None and f.value(site="serve.dispatch") >= 1
+
+
+# -- deadlines + load shedding ----------------------------------------------
+
+
+def test_slot_deadline_eviction_timeout_outcome():
+    """A live slot past its deadline is evicted between blocks with
+    outcome "timeout" and its partial tokens; slot-mates continue."""
+    t = [0.0]
+    eng = ContinuousBatchingEngine(
+        PARAMS, CFG, max_slots=2, max_len=64, clock=lambda: t[0]
+    )
+    eng.submit("slow", [1, 2, 3], 40, deadline_s=5.0)
+    eng.submit("ok", [4, 5, 6], 4)
+    for _ in range(3):
+        eng.step()
+    t[0] = 10.0  # past slow's deadline
+    res = eng.run()
+    assert res["slow"].outcome == "timeout"
+    assert 0 < len(res["slow"].tokens) < 40
+    # the partial prefix matches the fault-free stream (nothing bogus)
+    full = _sequential([1, 2, 3], 40)
+    assert res["slow"].tokens == full[: len(res["slow"].tokens)]
+    assert res["ok"].tokens == _sequential([4, 5, 6], 4)
+    assert eng.metrics.outcomes["timeout"] == 1
+
+
+def test_queue_deadline_shedding():
+    """A queued request whose deadline lapses while waiting is shed
+    (``rejected:timeout``) without ever touching the device."""
+    t = [0.0]
+    eng = ContinuousBatchingEngine(
+        PARAMS, CFG, max_slots=1, max_len=64, clock=lambda: t[0]
+    )
+    eng.submit("hog", [1, 2, 3], 12)
+    eng.submit("stale", [4, 5], 4, deadline_s=1.0)
+    eng.step()  # hog admitted; stale waits
+    t[0] = 2.0  # stale's deadline passes in the queue
+    res = eng.run()
+    assert res["hog"].tokens == _sequential([1, 2, 3], 12)
+    assert res["stale"].outcome == "timeout"
+    assert res["stale"].tokens == []
+    assert eng.metrics.rejected["timeout"] == 1
+    snap = eng.metrics.snapshot()
+    assert snap["rejected_timeout"] == 1
+    # shed before prefill: exactly one admission happened (the hog)
+    assert snap["dispatches_prefill"] == 1
+
+
+def test_submit_rejects_nonpositive_deadline():
+    from edl_tpu.serving.scheduler import AdmissionError
+
+    eng = ContinuousBatchingEngine(PARAMS, CFG, max_slots=1, max_len=32)
+    with pytest.raises(AdmissionError) as e:
+        eng.submit("bad", [1, 2], 3, deadline_s=0.0)
+    assert e.value.reason == "bad_request"
+
+
+# -- run(max_steps) drains in-flight blocks (satellite) ----------------------
+
+
+def test_run_max_steps_drains_inflight():
+    """run(max_steps) used to return with dispatched-but-undrained
+    blocks, silently missing tokens the device already produced; it
+    must drain before returning."""
+    eng = ContinuousBatchingEngine(
+        PARAMS, CFG, max_slots=1, max_len=64, horizon=8
+    )
+    eng.submit("a", [1, 2, 3], 5)  # finishes inside the first block
+    res = eng.run(max_steps=1)  # step 1 admits + dispatches, no drain yet
+    assert not eng._inflight
+    assert res["a"].tokens == _sequential([1, 2, 3], 5)
+    assert res["a"].outcome == "done"
