@@ -392,6 +392,38 @@ def run_top(args) -> int:
         time.sleep(args.interval)
 
 
+def run_postmortem(args) -> int:
+    """Reconstruct timelines + incidents from a flight-recorder dump
+    (obs/events.py JSONL — a `tracing`-style dump, a crash-dump black
+    box from EDL_BLACKBOX_DIR, or a live exporter's /events URL) and
+    optionally enforce the CI contracts: --assert-recovered proves
+    every injected serving fault chained into a recorded recovery
+    (fault -> recover -> re-prefill -> finish per affected rid);
+    --assert-no-incidents proves a fault-free lane's timeline is
+    clean. Device-free: analysis is pure event-log work."""
+    from edl_tpu.obs import postmortem as pm
+
+    try:
+        evs = pm.load_events(args.source)
+    except (OSError, ValueError) as e:
+        print(f"cannot load events from {args.source!r}: {e}",
+              file=sys.stderr)
+        return 2
+    print(pm.render_report(evs, rid=args.rid, window_s=args.window))
+    problems = []
+    if args.assert_recovered:
+        problems += pm.verify_recovered(evs, site_prefix=args.sites)
+    if args.assert_no_incidents:
+        problems += pm.verify_no_incidents(evs)
+    if problems:
+        for p in problems:
+            print(f"POSTMORTEM FAIL: {p}", file=sys.stderr)
+        return 1
+    if args.assert_recovered or args.assert_no_incidents:
+        print("postmortem assertions OK")
+    return 0
+
+
 def run_export_status(args) -> int:
     """Inspect (and optionally fetch) the latest servable export — the
     consumer side of the save_inference_model contract (reference:
@@ -1015,6 +1047,45 @@ def build_parser() -> argparse.ArgumentParser:
     v = sub.add_parser("validate", help="parse + validate a manifest")
     v.add_argument("manifest")
     v.set_defaults(fn=run_validate)
+
+    pmn = sub.add_parser(
+        "postmortem",
+        help="analyze a flight-recorder dump (or a live /events URL): "
+        "per-request timelines, incident summary, fault->recovery "
+        "chains; CI assertions for the chaos lane",
+    )
+    pmn.add_argument(
+        "source",
+        help="events JSONL path (a recorder dump or an EDL_BLACKBOX_DIR "
+        "crash dump) or an exporter URL / host:port (scrapes /events)",
+    )
+    pmn.add_argument(
+        "--rid", default=None,
+        help="render only this request's timeline",
+    )
+    pmn.add_argument(
+        "--window", type=float, default=5.0,
+        help="seconds of follow-on events attached to each injected "
+        "fault in the incident summary",
+    )
+    pmn.add_argument(
+        "--sites", default="serve.",
+        help="site prefix --assert-recovered checks (default: the "
+        "serving fault points)",
+    )
+    pmn.add_argument(
+        "--assert-recovered", action="store_true",
+        help="exit 1 unless every injected fault at --sites is "
+        "followed by a recorded recovery whose requests re-prefilled "
+        "and finished (a dump with no such faults also fails)",
+    )
+    pmn.add_argument(
+        "--assert-no-incidents", action="store_true",
+        help="exit 1 if the timeline shows any injected fault, "
+        "recovery, error event, timeout, failure, or heartbeat "
+        "degradation (the fault-free CI lane)",
+    )
+    pmn.set_defaults(fn=run_postmortem)
 
     ex = sub.add_parser(
         "export-status",

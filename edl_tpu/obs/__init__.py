@@ -35,6 +35,14 @@ from edl_tpu.obs.fleet import (  # noqa: F401
     aggregate_snapshots,
     bridge_tracer,
     collect_fleet,
+    collect_fleet_events,
+    events_key,
     metrics_key,
     registry_from_sample,
+)
+from edl_tpu.obs import events  # noqa: F401  (flight recorder)
+from edl_tpu.obs.events import (  # noqa: F401
+    FlightRecorder,
+    crash_dump,
+    default_recorder,
 )

@@ -4,8 +4,13 @@
   :class:`~edl_tpu.obs.metrics.MetricsRegistry` (or of a callable that
   rebuilds one per scrape — the coordinator's fleet aggregation mode);
 * ``/trace``    — the process tracer's chrome://tracing JSON (load in
-  Perfetto / chrome://tracing), with the ring-buffer ``dropped`` count
+  Perfetto / chrome://tracing) with the flight recorder's events
+  merged in as instant markers, and the ring-buffer ``dropped`` count
   in the metadata;
+* ``/events``   — the flight recorder's event log as JSONL, filterable
+  by ``?rid=``, ``?kind=``, ``?severity=`` and bounded by ``?n=``
+  (obs/events.py; the coordinator serves the worker-labeled fleet
+  union here via its events source);
 * ``/healthz``  — liveness JSON (status, uptime, pid).
 
 Pull-based on purpose (the Prometheus model): the process never blocks
@@ -23,7 +28,8 @@ import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Callable, Optional, Union
+from typing import Callable, List, Optional, Union
+from urllib.parse import parse_qs, urlsplit
 
 from edl_tpu.obs.metrics import MetricsRegistry, ensure_core_series
 from edl_tpu.utils.logging import kv_logger
@@ -40,6 +46,9 @@ class MetricsExporter:
     (re-evaluated per scrape; the fleet aggregator rebuilds a merged
     registry from coordinator KV each time). ``tracer`` defaults to
     the process-wide tracer so ``/trace`` always works.
+    ``events_source`` is a zero-arg callable returning event RECORDS
+    (dicts) for ``/events`` — defaults to the process flight
+    recorder; the coordinator passes its fleet-union collector.
     """
 
     def __init__(
@@ -49,6 +58,7 @@ class MetricsExporter:
         port: int = 0,
         host: str = "127.0.0.1",
         tracer=None,
+        events_source: Optional[Callable[[], List[dict]]] = None,
     ):
         if source is None:
             from edl_tpu.obs.metrics import default_registry
@@ -64,6 +74,11 @@ class MetricsExporter:
 
             tracer = tracing.tracer()
         self.tracer = tracer
+        if events_source is None:
+            from edl_tpu.obs import events as _events
+
+            events_source = lambda: _events.default_recorder().records()  # noqa: E731
+        self._events = events_source
         self._host = host
         self._want_port = port
         self._t0 = time.monotonic()
@@ -89,16 +104,22 @@ class MetricsExporter:
             server_version = "edl-obs/1"
 
             def do_GET(self):  # noqa: N802 (http.server API)
-                path = self.path.split("?", 1)[0]
+                parts = urlsplit(self.path)
+                path = parts.path
                 try:
                     if path == "/metrics":
                         body = exporter.render_metrics().encode()
                         ctype = CONTENT_TYPE_METRICS
                     elif path == "/trace":
                         body = json.dumps(
-                            exporter.tracer.to_chrome_doc()
+                            exporter.render_trace()
                         ).encode()
                         ctype = "application/json"
+                    elif path == "/events":
+                        body = exporter.render_events(
+                            parse_qs(parts.query)
+                        ).encode()
+                        ctype = "application/x-ndjson"
                     elif path in ("/", "/healthz"):
                         body = json.dumps(
                             {
@@ -107,7 +128,10 @@ class MetricsExporter:
                                     time.monotonic() - exporter._t0, 3
                                 ),
                                 "pid": os.getpid(),
-                                "endpoints": ["/metrics", "/trace", "/healthz"],
+                                "endpoints": [
+                                    "/metrics", "/trace", "/events",
+                                    "/healthz",
+                                ],
                             }
                         ).encode()
                         ctype = "application/json"
@@ -162,13 +186,48 @@ class MetricsExporter:
     def render_metrics(self) -> str:
         return self._collect().render()
 
+    def render_trace(self) -> dict:
+        """Chrome-trace doc: tracer spans + flight-recorder events
+        merged as instant markers (one Perfetto load shows both). The
+        fleet events source serves records without a process timebase,
+        so only the LOCAL recorder merges into /trace — /events is
+        the fleet surface."""
+        from edl_tpu.obs import events as _events
+
+        return _events.default_recorder().to_chrome_doc(self.tracer)
+
+    def render_events(self, qs: Optional[dict] = None) -> str:
+        """JSONL of the events source, filtered by ``rid``/``kind``/
+        ``severity`` query params and bounded by ``n`` (newest kept)."""
+        qs = qs or {}
+        first = lambda k: (qs.get(k) or [None])[0]  # noqa: E731
+        rid, kind, sev = first("rid"), first("kind"), first("severity")
+        recs = self._events()
+        if rid is not None:
+            recs = [r for r in recs if (r.get("corr") or {}).get("rid") == rid]
+        if kind is not None:
+            recs = [r for r in recs if r.get("kind") == kind]
+        if sev is not None:
+            recs = [r for r in recs if r.get("severity") == sev]
+        n = first("n")
+        if n is not None:
+            try:
+                recs = recs[-max(0, int(n)):]
+            except ValueError:
+                pass
+        return "\n".join(
+            json.dumps(r, default=str, separators=(",", ":")) for r in recs
+        ) + ("\n" if recs else "")
+
 
 def start_exporter(
-    source=None, *, port: int = 0, host: str = "127.0.0.1", tracer=None
+    source=None, *, port: int = 0, host: str = "127.0.0.1", tracer=None,
+    events_source=None,
 ) -> MetricsExporter:
     """Convenience: construct + start (``port=0`` = ephemeral)."""
     return MetricsExporter(
-        source, port=port, host=host, tracer=tracer
+        source, port=port, host=host, tracer=tracer,
+        events_source=events_source,
     ).start()
 
 

@@ -31,10 +31,15 @@ from edl_tpu.utils.logging import kv_logger
 log = kv_logger("obs")
 
 METRICS_KV_PREFIX = "metrics"  # {job}/metrics/{worker} holds snapshot JSON
+EVENTS_KV_PREFIX = "events"  # {job}/events/{worker} holds a JSONL window
 
 
 def metrics_key(job: str, worker: str) -> str:
     return f"{job}/{METRICS_KV_PREFIX}/{worker}"
+
+
+def events_key(job: str, worker: str) -> str:
+    return f"{job}/{EVENTS_KV_PREFIX}/{worker}"
 
 
 class MetricsPusher:
@@ -61,11 +66,20 @@ class MetricsPusher:
         interval_s: float = 10.0,
         registry: Optional[MetricsRegistry] = None,
         backoff_cap_s: float = 300.0,
+        events_publish: Optional[Callable[[str], None]] = None,
+        events_window: int = 256,
+        recorder=None,
     ):
         self._publish = publish
         self.interval_s = max(float(interval_s), 0.1)
         self.backoff_cap_s = max(float(backoff_cap_s), self.interval_s)
         self.registry = registry or default_registry()
+        # flight-recorder window rides the same cadence/backoff as the
+        # metric snapshot (same KV plane, same failure handling): the
+        # coordinator's /events shows each worker's recent timeline
+        self._events_publish = events_publish
+        self.events_window = events_window
+        self._recorder = recorder
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._failing = False
@@ -82,6 +96,14 @@ class MetricsPusher:
             # registry serialize + the injected publish
             faults.fault_point("metrics.push")
             self._publish(self.registry.snapshot_json())
+            if self._events_publish is not None:
+                rec = self._recorder
+                if rec is None:
+                    from edl_tpu.obs import events as _events
+
+                    rec = _events.default_recorder()
+                # single-line doc: coordinator KV is a line protocol
+                self._events_publish(rec.window_json(self.events_window))
             self.pushes += 1
             self._failing = False
             self._fail_streak = 0
@@ -162,6 +184,35 @@ def collect_fleet(client, job: str, extra_sources: Iterable[str] = ()) -> Metric
     g = reg.gauge("edl_fleet_reporting_workers", "workers with a pushed metrics snapshot")
     g.set(len(snaps))
     return reg
+
+
+def collect_fleet_events(
+    client, job: str, extra_sources: Iterable[str] = ()
+) -> list:
+    """Coordinator-side fleet log: read every live member's pushed
+    flight-recorder window from KV, tag each record with its worker
+    (unless the worker already stamped its context), and merge in
+    causal order (wall time, then per-process seq). Undecodable
+    windows are skipped like bad metric snapshots — a half-written KV
+    value must not kill the scrape."""
+    from edl_tpu.obs.events import load_jsonl
+
+    names = [m.name for m in client.members()]
+    names.extend(extra_sources)
+    merged: list = []
+    for name in names:
+        raw = client.kv_get(events_key(job, name))
+        if not raw:
+            continue
+        try:
+            recs = load_jsonl(raw)
+        except ValueError:
+            continue  # a window with no events yet
+        for r in recs:
+            r.setdefault("corr", {}).setdefault("worker", name)
+        merged.extend(recs)
+    merged.sort(key=lambda r: (r.get("t_wall", 0.0), r.get("seq", 0)))
+    return merged
 
 
 # ---------------------------------------------------------------------------

@@ -492,11 +492,48 @@ def ensure_core_series(reg: Optional[MetricsRegistry] = None) -> MetricsRegistry
     # tracing bridge (obs/fleet.py bridge_tracer)
     r.histogram("edl_span_seconds", "tracer span durations by name", ("name",))
     r.counter("edl_trace_spans_dropped_total", "spans evicted from the tracer ring buffer")
+    # flight recorder (obs/events.py)
+    r.counter("edl_events_total", "flight-recorder events by kind", ("kind",))
+    r.counter(
+        "edl_events_dropped_total",
+        "flight-recorder events evicted from the bounded ring",
+    )
     return r
 
 
 # ---------------------------------------------------------------------------
 # Prometheus text parsing (the `edl top` / test-side consumer)
+
+
+def _unescape_label(v: str) -> str:
+    """Invert :func:`_escape_label` in ONE left-to-right pass. The old
+    chained ``.replace`` corrupted values where a literal backslash
+    preceded an ``n`` or a quote: ``\\`` + ``n`` renders as ``\\\\n``,
+    and replacing ``\\n`` first turns the escaped backslash's second
+    character into a newline."""
+    if "\\" not in v:
+        return v
+    out: List[str] = []
+    i, n = 0, len(v)
+    while i < n:
+        c = v[i]
+        if c == "\\" and i + 1 < n:
+            nxt = v[i + 1]
+            if nxt == "\\":
+                out.append("\\")
+                i += 2
+                continue
+            if nxt == '"':
+                out.append('"')
+                i += 2
+                continue
+            if nxt == "n":
+                out.append("\n")
+                i += 2
+                continue
+        out.append(c)
+        i += 1
+    return "".join(out)
 
 
 def parse_prometheus_text(
@@ -516,12 +553,21 @@ def parse_prometheus_text(
             name, rest = line.split("{", 1)
             labels_raw, _, val = rest.rpartition("}")
             labels: Dict[str, str] = {}
-            # split on commas not inside quotes
-            buf, depth, parts = "", False, []
+            # split on commas not inside quotes, honoring backslash
+            # escapes (a \" inside a value must not close the quote)
+            buf, inq, esc, parts = "", False, False, []
             for ch in labels_raw:
+                if esc:
+                    buf += ch
+                    esc = False
+                    continue
+                if inq and ch == "\\":
+                    buf += ch
+                    esc = True
+                    continue
                 if ch == '"':
-                    depth = not depth
-                if ch == "," and not depth:
+                    inq = not inq
+                if ch == "," and not inq:
                     parts.append(buf)
                     buf = ""
                 else:
@@ -532,11 +578,12 @@ def parse_prometheus_text(
                 if "=" not in p:
                     continue
                 k, v = p.split("=", 1)
-                v = v.strip().strip('"')
-                labels[k.strip()] = (
-                    v.replace('\\"', '"').replace("\\n", "\n")
-                    .replace("\\\\", "\\")
-                )
+                # exactly ONE surrounding quote pair — str.strip('"')
+                # would eat a trailing quote that belongs to a \" escape
+                v = v.strip()
+                if len(v) >= 2 and v[0] == '"' and v[-1] == '"':
+                    v = v[1:-1]
+                labels[k.strip()] = _unescape_label(v)
             try:
                 fval = float(val.strip().split()[0])
             except (ValueError, IndexError):

@@ -93,6 +93,21 @@ def summarize(fams: _Fams) -> List[str]:
             f"bytes={_total(fams, 'edl_checkpoint_bytes_total'):.0f}"
         )
 
+    # incident strip: fleet health (sourced from the flight-recorder
+    # counters + the robustness series) without opening any dumps —
+    # shown only when something is actually wrong/noteworthy
+    recov = _total(fams, "edl_serving_recoveries_total")
+    injected = _total(fams, "edl_faults_injected_total")
+    hb = _total(fams, "edl_worker_heartbeat_degraded")
+    ev_dropped = _total(fams, "edl_events_dropped_total")
+    log_errors = _total(fams, "edl_events_total", kind="log.error")
+    if recov or injected or hb or ev_dropped or log_errors:
+        lines.append(
+            f"INCIDENT recoveries={recov:.0f} faults_injected={injected:.0f} "
+            f"hb_degraded={hb:.0f} log_errors={log_errors:.0f} "
+            f"dropped_events={ev_dropped:.0f}"
+        )
+
     workers = _total(fams, "edl_fleet_reporting_workers")
     if workers:
         lines.append(f"FLEET    reporting_workers={workers:.0f}")

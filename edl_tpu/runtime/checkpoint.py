@@ -65,6 +65,16 @@ def _obs_io(direction: str, kind: str, dt_s: float, nbytes: int) -> None:
         ).inc(nbytes, op=direction)
 
 
+def _emit_ckpt(event: str, step: int, **attrs) -> None:
+    """Flight-recorder entry (ckpt.save / ckpt.commit / ckpt.load)
+    keyed by step — the restore-point decisions a postmortem needs to
+    know when explaining which state a recovery rolled back to.
+    ``attrs`` carries the format (``fmt`` = dense | shards)."""
+    from edl_tpu.obs import events
+
+    events.emit(event, step=step, **attrs)
+
+
 def snapshot(state: TrainState) -> TrainState:
     """Device → host RAM (step one of the reshard protocol)."""
     return TrainState(
@@ -293,6 +303,8 @@ def save(path: str, state: TrainState, metadata: Dict[str, Any] = None) -> None:
         "write", "dense", time.perf_counter() - t0,
         sum(int(v.nbytes) for v in payload.values()),
     )
+    # the dense save IS the commit (single rename): one timeline entry
+    _emit_ckpt("ckpt.commit", int(np.asarray(host.step)), fmt="dense")
 
 
 def load(path: str, like: TrainState) -> TrainState:
@@ -305,6 +317,7 @@ def load(path: str, like: TrainState) -> TrainState:
         "read", "dense", time.perf_counter() - t0,
         sum(int(v.nbytes) for v in data.values()),
     )
+    _emit_ckpt("ckpt.load", int(np.asarray(data["step"])), fmt="dense")
 
     def _fill(tree, prefix):
         treedef = jax.tree_util.tree_structure(tree)
@@ -515,6 +528,7 @@ def save_shards(
         "write", "shards", time.perf_counter() - t0,
         sum(int(a.nbytes) for a in payload.values()),
     )
+    _emit_ckpt("ckpt.save", snap.step, fmt="shards", rank=rank, world=world)
     return fname
 
 
@@ -545,6 +559,7 @@ def write_manifest(
     with open(tmp, "w") as f:
         json.dump(doc, f)
     os.replace(tmp, os.path.join(d, "manifest.json"))
+    _emit_ckpt("ckpt.commit", snap.step, fmt="shards", files=len(doc["files"]))
 
 
 def latest_manifest(root: str) -> Optional[Dict[str, Any]]:
@@ -891,6 +906,7 @@ def load_sharded(
     finally:
         index.close()
         _obs_io("read", "shards", time.perf_counter() - t0, 0)
+        _emit_ckpt("ckpt.load", int(manifest["step"]), fmt="shards")
 
 
 def template_schema(like: TrainState) -> Tuple[Dict[str, Tuple[int, ...]], Dict[str, str]]:

@@ -52,6 +52,19 @@ def _rpc_counters():
         ),
     )
 
+
+def _emit_rpc_error(op: str, err: Exception) -> None:
+    """Flight-recorder entry for a failed coordinator round trip —
+    error-path only (the happy path stays a counter inc), so RPC drops
+    land on the same timeline as the reconnects and recoveries they
+    cause."""
+    from edl_tpu.obs import events
+
+    events.emit(
+        "coord.rpc_error", severity="warn", op=op,
+        error=f"{type(err).__name__}: {err}",
+    )
+
 _NATIVE_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
     "native",
@@ -382,6 +395,7 @@ class CoordinatorClient:
                 except (ConnectionError, OSError, socket.timeout) as e:
                     self.close()
                     reconnects.inc()
+                    _emit_rpc_error(line.split(" ", 1)[0], e)
                     if time.monotonic() >= deadline:
                         raise ConnectionError(
                             f"coordinator unreachable after "

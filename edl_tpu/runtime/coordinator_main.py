@@ -79,9 +79,15 @@ def main(argv=None) -> int:
     exporter = obs.start_exporter(
         lambda: obs.collect_fleet(client, a.job, EXTRA_METRIC_SOURCES),
         port=a.metrics_port,
+        # /events here is the worker-labeled FLEET log: the union of
+        # every member's pushed flight-recorder window ({job}/events/*)
+        events_source=lambda: obs.collect_fleet_events(
+            client, a.job, EXTRA_METRIC_SOURCES
+        ),
     )
     print(
-        f"coordinator on :{a.port}; fleet metrics at {exporter.url}/metrics",
+        f"coordinator on :{a.port}; fleet metrics at {exporter.url}/metrics "
+        f"(fleet event log at /events)",
         flush=True,
     )
     try:

@@ -41,6 +41,7 @@ from edl_tpu.train.trainer import (
     make_train_step,
     shard_state,
 )
+from edl_tpu.obs import events as flight
 from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.utils import tracing
 from edl_tpu.utils.logging import Timer, kv_logger
@@ -307,7 +308,12 @@ class ElasticTrainer:
         prev = self.n_workers
         step_at = self._host_step
         used_fallback = False
+        # reshard_epoch: this trainer's reshard ordinal — the flight-
+        # recorder correlation key tying begin/end/recompile together
+        ep = len(self.report.reshards)
         log.info("reshard begin", from_workers=prev, to_workers=target)
+        flight.emit("reshard.begin", reshard_epoch=ep, step=step_at,
+                    from_workers=prev, to_workers=target)
         with Timer() as stall, tracing.span(
             "reshard", from_workers=prev, to_workers=target, step=step_at
         ):
@@ -347,6 +353,12 @@ class ElasticTrainer:
         )
         self.report.reshards.append(ev)
         _obs_reshard(ev)
+        flight.emit(
+            "reshard.end", reshard_epoch=ep, step=step_at,
+            from_workers=prev, to_workers=target,
+            stall_s=round(stall.elapsed, 6),
+            path="host" if used_fallback else "device",
+        )
         log.info(
             "reshard done",
             from_workers=prev,
@@ -386,6 +398,39 @@ class ElasticTrainer:
         )
         t0 = time.perf_counter()
         raw_losses = []  # device arrays; materialized once after the loop
+        try:
+            self._train_steps_inner(
+                data_fn, n_steps, h_step, h_data, c_examples, raw_losses
+            )
+        except Exception as e:
+            # the trainer's black-box escape hatch: record the failure
+            # and dump the flight ring (EDL_BLACKBOX_DIR) BEFORE
+            # re-raising, so the crash is explainable post-hoc
+            flight.emit(
+                "trainer.crash", severity="error", step=self._host_step,
+                error=f"{type(e).__name__}: {e}",
+            )
+            flight.crash_dump("trainer", e)
+            raise
+        tb = time.perf_counter()
+        jax.block_until_ready(self.state.params)
+        h_block.observe(time.perf_counter() - tb)
+        self.report.train_seconds += time.perf_counter() - t0
+        self.report.losses.extend(float(x) for x in raw_losses)
+        if raw_losses:
+            reg.gauge("edl_train_loss", "most recent training loss").set(
+                float(raw_losses[-1])
+            )
+        if self.report.train_seconds > 0:
+            reg.gauge(
+                "edl_train_examples_per_sec",
+                "training throughput over the last report window",
+            ).set(self.report.examples_per_sec)
+        return self.report
+
+    def _train_steps_inner(
+        self, data_fn, n_steps, h_step, h_data, c_examples, raw_losses
+    ) -> None:
         for _ in range(n_steps):
             self._maybe_rescale()
             ts = time.perf_counter()
@@ -422,18 +467,3 @@ class ElasticTrainer:
             raw_losses.append(metrics["loss"])
             self.maybe_checkpoint()
             h_step.observe(time.perf_counter() - ts)
-        tb = time.perf_counter()
-        jax.block_until_ready(self.state.params)
-        h_block.observe(time.perf_counter() - tb)
-        self.report.train_seconds += time.perf_counter() - t0
-        self.report.losses.extend(float(x) for x in raw_losses)
-        if raw_losses:
-            reg.gauge("edl_train_loss", "most recent training loss").set(
-                float(raw_losses[-1])
-            )
-        if self.report.train_seconds > 0:
-            reg.gauge(
-                "edl_train_examples_per_sec",
-                "training throughput over the last report window",
-            ).set(self.report.examples_per_sec)
-        return self.report

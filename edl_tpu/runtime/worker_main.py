@@ -68,6 +68,18 @@ log = kv_logger("worker")
 _POLL_S = 0.02
 
 
+def _emit_worker_event(kind: str, worker: str, severity: str = "info", **attrs):
+    """Flight-recorder emit keyed by worker (join/leave/heartbeat —
+    the membership decisions a fleet postmortem reconstructs).
+    Telemetry must never take the worker down."""
+    try:
+        from edl_tpu.obs import events
+
+        events.emit(kind, severity, worker=worker, **attrs)
+    except Exception:  # pragma: no cover - defensive
+        pass
+
+
 # --------------------------------------------------------------------------
 # config: runtime/worker_config.py (re-exported: the EDL_* env contract)
 
@@ -236,6 +248,9 @@ class ElasticWorker:
         cfg = self.cfg
         obs.ensure_core_series()
         obs.bridge_tracer()
+        # every flight-recorder event this process emits from here on
+        # carries worker identity — the fleet log's correlation key
+        obs.events.default_recorder().set_context(worker=cfg.worker_id)
         if cfg.metrics_port >= 0:
             try:
                 self._exporter = obs.start_exporter(port=cfg.metrics_port)
@@ -249,12 +264,18 @@ class ElasticWorker:
                 log.warn("metrics exporter failed to bind", error=str(e))
         if cfg.metrics_push_s > 0:
             key = obs.metrics_key(cfg.job, cfg.worker_id)
+            ekey = obs.events_key(cfg.job, cfg.worker_id)
             # the main client is lock-serialized per roundtrip, so the
             # pusher thread can share it (same pattern would hold for a
-            # dedicated connection; sharing avoids a third socket)
+            # dedicated connection; sharing avoids a third socket).
+            # The flight-recorder window rides the same cadence so the
+            # coordinator's /events shows the worker-labeled fleet log.
             self._pusher = obs.MetricsPusher(
                 lambda payload: self.client.kv_put(key, payload),
                 interval_s=cfg.metrics_push_s,
+                events_publish=lambda payload: self.client.kv_put(
+                    ekey, payload
+                ),
             ).start()
 
     def _telemetry_stop(self) -> None:
@@ -760,11 +781,17 @@ class ElasticWorker:
                 c = CoordinatorClient(cfg.coord_host, cfg.coord_port, 5.0)
             if not c.heartbeat(cfg.worker_id) and not self._leaving:
                 log.warn("TTL-evicted while alive; re-registering")
+                _emit_worker_event(
+                    "worker.re_register", cfg.worker_id, severity="warn",
+                )
                 c.register(cfg.worker_id, incarnation)
             if self._hb_degraded:
                 self._hb_degraded = False
                 gauge.set(0)
                 log.info("heartbeat recovered")
+                _emit_worker_event(
+                    "worker.heartbeat_recovered", cfg.worker_id
+                )
             return c
         except Exception as e:
             if not self._hb_degraded:
@@ -773,6 +800,10 @@ class ElasticWorker:
                 log.warn(
                     "heartbeat degraded; retrying until departure",
                     error=f"{type(e).__name__}: {e}",
+                )
+                _emit_worker_event(
+                    "worker.heartbeat_degraded", cfg.worker_id,
+                    severity="warn", error=f"{type(e).__name__}: {e}",
                 )
             try:
                 if c is not None:
@@ -792,6 +823,10 @@ class ElasticWorker:
             epoch, rank, world, addr, members = self._rendezvous()
             log.info(
                 "epoch up", epoch=epoch, rank=rank, world=world, dist=addr
+            )
+            _emit_worker_event(
+                "worker.join", self.cfg.worker_id,
+                epoch=epoch, rank=rank, world=world,
             )
             try:
                 _initialize_distributed(addr, world, rank)
@@ -1276,6 +1311,9 @@ class ElasticWorker:
         if rank == 0:
             cl.kv_put(self._k("phase"), "succeeded")
         log.info("job complete", worker=self.cfg.worker_id)
+        _emit_worker_event(
+            "worker.leave", self.cfg.worker_id, reason="complete"
+        )
         cl.leave(self.cfg.worker_id)
         cl.release_worker(self.cfg.worker_id)
         return 0
@@ -1283,6 +1321,9 @@ class ElasticWorker:
     def _depart(self, code: int) -> int:
         cl = self.client
         log.info("departing (scale-down)", worker=self.cfg.worker_id)
+        _emit_worker_event(
+            "worker.leave", self.cfg.worker_id, reason="scale-down"
+        )
         cl.release_worker(self.cfg.worker_id)
         cl.leave(self.cfg.worker_id)
         cl.kv_del(self._k("leaving", self.cfg.worker_id))

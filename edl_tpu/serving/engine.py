@@ -82,6 +82,13 @@ blocks the engine evicts overdue slots (outcome ``"timeout"``) and
 sheds queued requests whose deadline passed while waiting
 (``rejected:timeout``) — overload drops the stalest work instead of
 growing the queue without bound.
+
+**Flight recorder.** Every request-lifecycle decision (submit / admit
+/ reject / prefill / block / finish) and every recovery pass lands in
+the process flight recorder (edl_tpu/obs/events.py) keyed by ``rid``,
+so ``edl postmortem`` reconstructs any request's timeline — and each
+``_recover`` dumps the ring to ``$EDL_BLACKBOX_DIR`` (when set) before
+rebuilding, the black box that explains what led to the crash.
 """
 
 from __future__ import annotations
@@ -104,6 +111,7 @@ from edl_tpu.serving.scheduler import (
     Request,
     RequestQueue,
 )
+from edl_tpu.obs import events as flight
 from edl_tpu.utils import faults, tracing
 from edl_tpu.utils.logging import kv_logger
 
@@ -326,6 +334,13 @@ class ContinuousBatchingEngine:
         shape = (L, max_slots, max_len, kvh, hd)
         self._kc = jnp.zeros(shape, cfg.dtype)
         self._vc = jnp.zeros(shape, cfg.dtype)
+        # lanes whose slot was evicted while the DEVICE row was still
+        # active (deadline evictions are host-bookkeeping only): blocks
+        # dispatched before the eviction still carry the old request's
+        # real tokens in that lane, so the lane must not be reused
+        # until every such block has drained (see _admit). A fresh
+        # device state has no active rows — always starts empty.
+        self._stale: set = set()
         # dispatched-but-undrained block token matrices (device arrays);
         # depth <= 2 transiently inside step(), <= 1 between steps —
         # the double buffer
@@ -350,22 +365,22 @@ class ContinuousBatchingEngine:
         is a relative latency budget from now: past it the request is
         shed from the queue or its slot evicted (outcome "timeout")."""
         self.metrics.on_submit(rid)
+        flight.emit("serve.submit", rid=rid, prompt_len=len(prompt),
+                    max_new=int(max_new))
         if rid in self.results or any(
             s is not None and s.rid == rid for s in self._slots
         ):
-            self.metrics.on_reject(rid, "bad_request")
-            raise AdmissionError("bad_request", f"duplicate request id {rid!r}")
+            self._reject(rid, "bad_request", f"duplicate request id {rid!r}")
         bad = [t for t in prompt if not 0 <= int(t) < self.cfg.vocab]
         if bad:
-            self.metrics.on_reject(rid, "bad_request")
-            raise AdmissionError(
-                "bad_request",
+            self._reject(
+                rid, "bad_request",
                 f"{rid}: prompt tokens {bad[:4]} outside [0, {self.cfg.vocab})",
             )
         if deadline_s is not None and deadline_s <= 0:
-            self.metrics.on_reject(rid, "bad_request")
-            raise AdmissionError(
-                "bad_request", f"{rid}: deadline_s must be > 0, got {deadline_s}"
+            self._reject(
+                rid, "bad_request",
+                f"{rid}: deadline_s must be > 0, got {deadline_s}",
             )
         try:
             self.queue.submit(
@@ -375,7 +390,16 @@ class ContinuousBatchingEngine:
             )
         except AdmissionError as e:
             self.metrics.on_reject(rid, e.reason)
+            flight.emit("serve.reject", severity="warn", rid=rid,
+                        reason=e.reason)
             raise
+
+    def _reject(self, rid: str, reason: str, msg: str) -> None:
+        """Typed admission rejection: counted once, on the timeline
+        once, then raised."""
+        self.metrics.on_reject(rid, reason)
+        flight.emit("serve.reject", severity="warn", rid=rid, reason=reason)
+        raise AdmissionError(reason, msg)
 
     # -- the engine loop ----------------------------------------------------
 
@@ -500,6 +524,8 @@ class ContinuousBatchingEngine:
             )
         self.metrics.on_dispatch("decode")
         self._assert_donated(*old)
+        flight.emit("serve.block", active=self.active_slots,
+                    horizon=self.horizon)
         # chaos site: a crash HERE is the worst case — the donated
         # inputs are dead, the carries are rebound, and the block's
         # token matrix is about to be lost
@@ -561,22 +587,35 @@ class ContinuousBatchingEngine:
         """Deadline enforcement between blocks: a live slot past its
         absolute deadline finishes NOW with what it has (outcome
         "timeout"). Bookkeeping-only like every eviction — the device
-        row keeps decoding until the slot is reused, drains skip it."""
+        row keeps decoding until the slot is reused, drains skip it.
+        Counted exactly ONCE, as completed{outcome=timeout} via
+        ``_finish`` — never also as a rejection. The lane is marked
+        STALE: unlike an EOS/budget finish, the device never froze
+        this row, so in-flight blocks still carry the old request's
+        real tokens in it and admission must drain them before reuse
+        (tests/test_serving.py pins the no-leak contract)."""
         now = self.clock()
         for i, sl in enumerate(self._slots):
             if sl is not None and sl.deadline is not None and now > sl.deadline:
                 self._finish(i, "timeout")
+                self._stale.add(i)
 
     def _shed_expired(self, req: Request) -> bool:
         """Queue-side load shedding: a popped request whose deadline
         passed while it waited is finished as ``rejected:timeout``
         without ever touching the device — an overloaded engine drops
         the stalest work instead of prefilling tokens nobody will
-        consume."""
+        consume. Counted exactly ONCE, as a rejection — deliberately
+        NOT through ``_finish``/``on_finish``: a shed request was
+        never admitted, so it must not inflate ``completed`` (the
+        double-count audit tests/test_serving.py pins)."""
         dl = req.deadline_at()
         if dl is None or self.clock() <= dl:
             return False
         self.metrics.on_reject(req.rid, "timeout")
+        flight.emit("serve.reject", severity="warn", rid=req.rid,
+                    reason="timeout", shed=True,
+                    queued_s=round(self.clock() - req.submit_s, 6))
         self.results[req.rid] = RequestResult(
             rid=req.rid, tokens=[], outcome="timeout"
         )
@@ -599,11 +638,21 @@ class ContinuousBatchingEngine:
             # only in this local — publish it so a prefill crash
             # requeues it at the head instead of losing it
             self._admitting = req
+            if slot in self._stale and self._inflight:
+                # the lane was deadline-evicted while its device row
+                # was still decoding: blocks dispatched before the
+                # eviction carry the OLD request's tokens in this lane,
+                # and replaying them into the new occupant would leak
+                # tokens across requests — sync them out first
+                emitted += self._drain_all()
+            self._stale.discard(slot)
             tok0 = self._prefill_into(
                 slot, req.prompt, req.max_new, req.eos_id,
-                site="serve.prefill",
+                site="serve.prefill", rid=req.rid,
             )
             self.metrics.on_admit(req.rid, len(req.prompt))
+            flight.emit("serve.admit", rid=req.rid, slot=slot,
+                        prompt_len=len(req.prompt))
             sl = _Slot(
                 rid=req.rid, prompt=list(req.prompt), max_new=req.max_new,
                 eos_id=req.eos_id, generated=[tok0],
@@ -626,6 +675,8 @@ class ContinuousBatchingEngine:
         max_new: int,
         eos_id: Optional[int],
         site: Optional[str] = None,
+        rid: Optional[str] = None,
+        replay: bool = False,
     ) -> int:
         """One prefill-insert dispatch: run ``seq`` through the bucketed
         prefill program, scatter its K/V into cache row ``slot``, reset
@@ -656,6 +707,8 @@ class ContinuousBatchingEngine:
             )
             self.metrics.on_dispatch("prefill")
             self._assert_donated(*old)
+            flight.emit("serve.prefill", rid=rid, slot=slot, bucket=tb,
+                        replay=replay)
             if site is not None:
                 # chaos site (admission only — recovery replays are
                 # not re-faulted at the same site, the dispatch sites
@@ -674,6 +727,11 @@ class ContinuousBatchingEngine:
             rid=sl.rid, tokens=list(sl.generated), outcome=outcome
         )
         self.metrics.on_finish(sl.rid, outcome)
+        flight.emit(
+            "serve.finish",
+            severity="info" if outcome in ("done", "eos") else "warn",
+            rid=sl.rid, outcome=outcome, tokens=len(sl.generated),
+        )
         # eviction is bookkeeping only: the device already froze the
         # row (active mask), the freed cache row is dead weight until
         # the next prefill-insert overwrites it, and the block program
@@ -716,6 +774,7 @@ class ContinuousBatchingEngine:
             live=self.active_slots,
         )
         with tracing.span("serving.recover"):
+            requeued = None
             if self._admitting is not None:
                 # the mid-admission request is charged like a slotted
                 # one — otherwise a request whose prefill always faults
@@ -728,8 +787,11 @@ class ContinuousBatchingEngine:
                         rid=req.rid, tokens=[], outcome="failed"
                     )
                     self.metrics.on_finish(req.rid, "failed")
+                    flight.emit("serve.finish", severity="warn",
+                                rid=req.rid, outcome="failed", tokens=0)
                 else:
                     self.queue.requeue_front(req)
+                    requeued = req.rid
             live = []
             for i, sl in enumerate(self._slots):
                 if sl is None:
@@ -741,6 +803,18 @@ class ContinuousBatchingEngine:
                     live.append(i)
             self.recoveries += 1
             self.metrics.on_recovery(len(live))
+            # the flight-recorder entry names every request this pass
+            # replays (postmortem verifies each one re-prefills and
+            # finishes), then the black box snapshots the timeline
+            # that LED here — before the rebuild mutates anything else
+            flight.emit(
+                "serve.recover", severity="warn",
+                error=f"{type(err).__name__}: {err}",
+                rids=[self._slots[i].rid for i in live],
+                requeued=requeued,
+                recovery_n=self.recoveries,
+            )
+            flight.crash_dump("serving", err)
             self._alloc_device_state()
             for i in live:
                 try:
@@ -758,7 +832,8 @@ class ContinuousBatchingEngine:
         sl = self._slots[slot]
         seq = sl.prompt + sl.generated
         remaining = sl.max_new - len(sl.generated)
-        tok = self._prefill_into(slot, seq, remaining, sl.eos_id)
+        tok = self._prefill_into(slot, seq, remaining, sl.eos_id,
+                                 rid=sl.rid, replay=True)
         sl.generated.append(tok)
         self.metrics.on_token(sl.rid)
         if sl.eos_id is not None and tok == sl.eos_id:

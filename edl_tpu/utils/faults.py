@@ -249,7 +249,7 @@ def counts() -> Dict[str, int]:
         }
 
 
-def _count_injection(site: str) -> None:
+def _count_injection(site: str, nth: int, action: str) -> None:
     # resolved per injection so a registry swap in tests takes effect;
     # injections are rare by construction, so the lookup cost is noise
     from edl_tpu.obs import metrics as obs_metrics
@@ -257,6 +257,15 @@ def _count_injection(site: str) -> None:
     obs_metrics.default_registry().counter(
         "edl_faults_injected_total", "injected faults by site", ("site",)
     ).inc(site=site)
+    # flight recorder: the injection lands on the SAME timeline as its
+    # consequences, so `edl postmortem` can verify every fault is
+    # followed by a recorded recovery (fault -> recover -> re-prefill
+    # -> finish, the chaos lane's chain contract)
+    from edl_tpu.obs import events
+
+    events.emit(
+        "fault.injected", severity="warn", site=site, nth=nth, action=action
+    )
 
 
 def fault_point(site: str) -> None:
@@ -274,8 +283,8 @@ def fault_point(site: str) -> None:
                 break
     if fire is None:
         return
-    _count_injection(site)
     spec = fire.spec
+    _count_injection(site, fire.calls, spec.action)
     if spec.action == "delay":
         time.sleep(spec.delay_s)
     elif spec.action == "drop":

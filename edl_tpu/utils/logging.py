@@ -15,6 +15,18 @@ from typing import Any
 _FORMAT = "%(asctime)s %(levelname)-5s [%(name)s] %(message)s"
 _configured = False
 
+# flight-recorder bridge: warn/error lines mirror into the process
+# event timeline when a recorder is installed (edl_tpu/obs/events.py
+# registers the sink at import; None = bridge off, zero overhead)
+_event_sink = None
+
+
+def set_event_sink(fn) -> None:
+    """Install ``fn(level, logger_name, msg, kv)`` as the warn/error
+    mirror, or None to detach."""
+    global _event_sink
+    _event_sink = fn
+
 
 def configure(level: str = "info", stream=None) -> None:
     """Install the root handler (reference flag: -log_level, cmd/edl/edl.go:18)."""
@@ -48,9 +60,13 @@ class KVLogger:
 
     def warn(self, msg: str, **kv: Any) -> None:
         self._log.warning(self._render(msg, kv))
+        if _event_sink is not None:
+            _event_sink("warn", self._log.name, msg, kv)
 
     def error(self, msg: str, **kv: Any) -> None:
         self._log.error(self._render(msg, kv))
+        if _event_sink is not None:
+            _event_sink("error", self._log.name, msg, kv)
 
 
 def kv_logger(name: str) -> KVLogger:

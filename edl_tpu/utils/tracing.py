@@ -57,6 +57,7 @@ class Tracer:
         self._lock = threading.Lock()
         self._spans: Deque[Span] = deque(maxlen=max_spans)
         self._t0 = time.perf_counter()
+        self.t0 = self._t0  # public timebase (flight-recorder merge)
         self.max_spans = max_spans
         self.enabled = True
         self.dropped = 0  # spans evicted after the ring filled

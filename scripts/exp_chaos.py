@@ -130,8 +130,10 @@ SERVING_PLANS = [
 ]
 
 
-def serving_lane(seed, n_requests, horizon=4):
+def serving_lane(seed, n_requests, horizon=4, events_dir=None):
     from edl_tpu.models import llama
+    from edl_tpu.obs import events as flight
+    from edl_tpu.obs import postmortem as pm
 
     cfg = llama.LlamaConfig.tiny(vocab=256)
     params = jax.jit(lambda: llama.init_params(jax.random.PRNGKey(1), cfg))()
@@ -140,15 +142,24 @@ def serving_lane(seed, n_requests, horizon=4):
     total_budget = sum(r["max_new"] for r in reqs)
     print(f"\n== serving lane: {len(reqs)} requests, {total_budget} token "
           f"budget, horizon={horizon} ==")
+    recorder = flight.default_recorder()
 
     faults.disarm()
+    recorder.clear()
     ref_eng = run_serving(params, cfg, reqs, horizon=horizon)
     ref = {rid: r.tokens for rid, r in ref_eng.results.items()}
     assert len(ref) == len(reqs), "fault-free run lost requests"
     assert ref_eng.recoveries == 0
+    # postmortem pass 1: the fault-free timeline must be incident-free
+    issues = pm.verify_no_incidents(recorder.records())
+    assert not issues, f"fault-free lane shows incidents: {issues}"
+    if events_dir:
+        recorder.dump(os.path.join(events_dir, "faultfree.jsonl"))
 
-    print(f"{'plan':<16} {'recoveries':>10} {'injected':>9} {'outcome':>8}")
+    print(f"{'plan':<16} {'recoveries':>10} {'injected':>9} {'chains':>7} "
+          f"{'outcome':>8}")
     for name, plan in SERVING_PLANS:
+        recorder.clear()
         before = injected_total()
         faults.arm(plan, seed=seed)
         eng = run_serving(params, cfg, reqs, horizon=horizon,
@@ -173,9 +184,19 @@ def serving_lane(seed, n_requests, horizon=4):
         assert 0 < eng.recoveries <= fired, (name, eng.recoveries, fired)
         snap = eng.metrics.snapshot()
         assert snap["recoveries"] == eng.recoveries
+        # postmortem pass 2: every injected fault must chain into a
+        # recorded recovery whose affected rids re-prefilled and
+        # finished — the flight recorder PROVES the recovery happened,
+        # not just that outputs match
+        chains = pm.fault_chains(recorder.records())
+        problems = pm.verify_recovered(recorder.records())
+        assert not problems, f"{name}: broken recovery chains: {problems}"
+        if events_dir:
+            recorder.dump(os.path.join(events_dir, f"chaos-{name}.jsonl"))
         print(f"{name:<16} {eng.recoveries:>10} {fired:>9.0f} "
-              f"{'OK':>8}")
-    print("serving lane OK: greedy tokens identical under every plan")
+              f"{len(chains):>7} {'OK':>8}")
+    print("serving lane OK: greedy tokens identical under every plan, "
+          "every fault's recovery chain recorded")
 
 
 # ---------------------------------------------------------------------------
@@ -381,7 +402,16 @@ def main():
         "--dryrun", action="store_true",
         help="CI chaos lane: fixed small workload, all invariants on",
     )
+    ap.add_argument(
+        "--events-dir", default=None,
+        help="dump per-lane flight-recorder JSONL here (faultfree.jsonl "
+        "+ chaos-<plan>.jsonl) for `edl postmortem` verification — the "
+        "CI runner pipes these through --assert-recovered / "
+        "--assert-no-incidents",
+    )
     args = ap.parse_args()
+    if args.events_dir:
+        os.makedirs(args.events_dir, exist_ok=True)
     assert not faults.armed(), (
         "refusing to run with a pre-armed EDL_FAULTS plan: the harness "
         "owns the fault schedule"
@@ -392,7 +422,7 @@ def main():
     n_leases = args.leases or (16 if args.dryrun else 32)
 
     t0 = time.perf_counter()
-    serving_lane(args.seed, n_requests)
+    serving_lane(args.seed, n_requests, events_dir=args.events_dir)
     backoff_lane()
     import tempfile
 

@@ -56,11 +56,32 @@ echo "== phase 5: deterministic chaos lane (exp_chaos --dryrun) =="
 # greedy token identity vs the fault-free serving run (incl. requests
 # mid-stream at the injected crash), bounded recovery counts, training
 # reaching the same step/loss under 5% coordinator RPC drops, and that
-# every armed fault actually fired
-JAX_PLATFORMS=cpu python scripts/exp_chaos.py --dryrun --seed 0
+# every armed fault actually fired. --events-dir dumps each lane's
+# flight-recorder timeline for the postmortem phase below.
+EVDIR="${TMPDIR:-/tmp}/edl-chaos-events.$$"
+rm -rf "$EVDIR"
+JAX_PLATFORMS=cpu python scripts/exp_chaos.py --dryrun --seed 0 \
+    --events-dir "$EVDIR"
 rc5=$?
 t5=$(date +%s)
 echo "== phase 5 done in $((t5 - t4))s (rc=$rc5) =="
-echo "== total $((t5 - t0))s =="
 
-[ "$rc1" -eq 0 ] && [ "$rc2" -eq 0 ] && [ "$rc3" -eq 0 ] && [ "$rc4" -eq 0 ] && [ "$rc5" -eq 0 ]
+echo "== phase 6: edl postmortem over the chaos flight-recorder dumps =="
+# the black-box contract, verified from OUTSIDE the harness process:
+# the fault-free lane's timeline is incident-free, and every chaos
+# lane's dump shows the causal chain fault_injected -> recover ->
+# re-prefill -> finish for each affected request
+rc6=0
+python -m edl_tpu.cli postmortem "$EVDIR/faultfree.jsonl" \
+    --assert-no-incidents > /dev/null || rc6=1
+for f in "$EVDIR"/chaos-*.jsonl; do
+  [ -e "$f" ] || { echo "no chaos dumps found in $EVDIR"; rc6=1; break; }
+  python -m edl_tpu.cli postmortem "$f" --assert-recovered > /dev/null \
+    || { echo "postmortem FAILED for $f"; rc6=1; }
+done
+rm -rf "$EVDIR"
+t6=$(date +%s)
+echo "== phase 6 done in $((t6 - t5))s (rc=$rc6) =="
+echo "== total $((t6 - t0))s =="
+
+[ "$rc1" -eq 0 ] && [ "$rc2" -eq 0 ] && [ "$rc3" -eq 0 ] && [ "$rc4" -eq 0 ] && [ "$rc5" -eq 0 ] && [ "$rc6" -eq 0 ]

@@ -1,0 +1,493 @@
+"""Flight recorder + postmortem (edl_tpu/obs/events.py,
+edl_tpu/obs/postmortem.py).
+
+The observability contract ISSUE 5 pins: a thread-safe bounded ring of
+typed, monotonically-sequenced, correlated events; JSONL dump/load;
+Perfetto merge; crash dumps to EDL_BLACKBOX_DIR; the /events endpoint
+with filters; the KVLogger warn/error bridge; fleet event collection;
+and the `edl postmortem` analyzer — including the acceptance chain
+``fault_injected -> recover -> re-prefill -> finish`` over a real
+engine crash.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import jax
+import pytest
+
+from edl_tpu import obs
+from edl_tpu.obs import events as flight
+from edl_tpu.obs import postmortem as pm
+from edl_tpu.utils import faults
+from edl_tpu.utils.logging import kv_logger
+
+
+# ---------------------------------------------------------------------------
+# recorder semantics
+
+
+def test_recorder_seq_ring_and_counts():
+    rec = flight.FlightRecorder(max_events=4, clock=lambda: 42.0)
+    for i in range(6):
+        rec.emit("k.a" if i % 2 == 0 else "k.b", rid=f"r{i}", n=i)
+    # bounded ring: newest 4 retained, 2 dropped-oldest, seq monotonic
+    assert len(rec) == 4 and rec.dropped == 2
+    seqs = [e.seq for e in rec.events()]
+    assert seqs == sorted(seqs) and seqs[-1] == 6
+    assert {e.corr["rid"] for e in rec.events()} == {"r2", "r3", "r4", "r5"}
+    # per-kind totals survive eviction
+    assert rec.counts() == {"k.a": 3, "k.b": 3}
+    # filters
+    assert len(rec.events(kind="k.a")) == 2  # r2, r4 retained
+    assert rec.events(rid="r5")[0].attrs["n"] == 5
+    rec.clear()
+    assert len(rec) == 0 and rec.dropped == 0 and rec.counts() == {}
+
+
+def test_recorder_context_and_severity():
+    rec = flight.FlightRecorder()
+    rec.set_context(worker="w3")
+    e = rec.emit("x", severity="warn", rid="a")
+    assert e.corr == {"worker": "w3", "rid": "a"}
+    rec.set_context(worker=None)  # clears
+    assert rec.emit("y").corr == {}
+    with pytest.raises(ValueError):
+        rec.emit("z", severity="fatal")
+
+
+def test_recorder_registry_counters():
+    reg = obs.default_registry()
+    fam = reg.counter("edl_events_total", "flight-recorder events by kind",
+                      ("kind",))
+    before = fam.value(kind="test.kind")
+    small = flight.FlightRecorder(max_events=1)
+    small.emit("test.kind")
+    small.emit("test.kind")  # evicts -> dropped counter too
+    assert fam.value(kind="test.kind") == before + 2
+    assert reg.counter("edl_events_dropped_total", "").value() >= 1
+
+
+def test_recorder_thread_safety_and_bounded_allocation():
+    rec = flight.FlightRecorder(max_events=512)
+
+    def work(t):
+        for i in range(1000):
+            rec.emit("race", rid=f"t{t}", n=i)
+
+    ts = [threading.Thread(target=work, args=(t,)) for t in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    # every emit counted exactly once; ring stayed bounded
+    assert rec.counts()["race"] == 4000
+    assert len(rec) == 512 and rec.dropped == 4000 - 512
+    seqs = [e.seq for e in rec.events()]
+    assert seqs == sorted(seqs)
+
+
+def test_emit_overhead_is_steady_state_cheap():
+    """The acceptance bound: an emit is one lock + deque append +
+    counter inc — comfortably under 1% of even a tiny CPU-dryrun block
+    (~ms). Generous ceiling so CI boxes never flake."""
+    rec = flight.FlightRecorder(max_events=1024)
+    rec.emit("warmup")
+    n = 2000
+    t0 = time.perf_counter()
+    for i in range(n):
+        rec.emit("bench", rid="r", n=i)
+    per = (time.perf_counter() - t0) / n
+    assert per < 200e-6, f"emit cost {per * 1e6:.1f}us"
+
+
+# ---------------------------------------------------------------------------
+# JSONL round trip + chrome merge
+
+
+def test_jsonl_dump_load_round_trip(tmp_path):
+    rec = flight.FlightRecorder(max_events=3)
+    for i in range(5):
+        rec.emit("k", rid=f"r{i}", n=i)
+    path = rec.dump(str(tmp_path / "flight.jsonl"))
+    loaded = flight.load_jsonl(path)
+    assert [e["corr"]["rid"] for e in loaded] == ["r2", "r3", "r4"]
+    assert loaded[0]["attrs"]["_ring_dropped"] == 2  # meta surfaced
+    assert all(e["kind"] == "k" for e in loaded)
+    # torn tail tolerated (a crash dump may be cut mid-line)
+    torn = open(path).read()[:-20]
+    assert len(flight.load_jsonl(torn)) >= 1
+    with pytest.raises(ValueError):
+        flight.load_jsonl("not json at all")
+
+
+def test_chrome_doc_merges_instant_events_with_spans():
+    from edl_tpu.utils import tracing
+
+    tr = tracing.Tracer()
+    with tr.span("phase.one"):
+        pass
+    rec = flight.FlightRecorder()
+    rec.emit("decision.a", rid="r1")
+    doc = rec.to_chrome_doc(tr)
+    by_ph = {}
+    for e in doc["traceEvents"]:
+        by_ph.setdefault(e["ph"], []).append(e["name"])
+    assert "phase.one" in by_ph["X"]  # span survived
+    assert "decision.a" in by_ph["i"]  # event merged as instant
+    inst = next(e for e in doc["traceEvents"] if e["ph"] == "i")
+    assert inst["args"]["rid"] == "r1" and inst["ts"] >= 0
+    assert doc["eventsDropped"] == 0
+
+
+# ---------------------------------------------------------------------------
+# crash dump black box
+
+
+def test_crash_dump_writes_blackbox(tmp_path, monkeypatch):
+    monkeypatch.delenv("EDL_BLACKBOX_DIR", raising=False)
+    assert flight.crash_dump("unit") is None  # unset -> no-op
+    monkeypatch.setenv("EDL_BLACKBOX_DIR", str(tmp_path / "bb"))
+    rec = flight.default_recorder()
+    rec.emit("before.crash", rid="r9")
+    path = flight.crash_dump("unit", RuntimeError("boom"))
+    assert path and os.path.exists(path)
+    loaded = flight.load_jsonl(path)
+    kinds = [e["kind"] for e in loaded]
+    assert "before.crash" in kinds
+    crash = next(e for e in loaded if e["kind"] == "crash")
+    assert crash["severity"] == "error"
+    assert "boom" in crash["attrs"]["error"]
+
+
+# ---------------------------------------------------------------------------
+# KVLogger bridge
+
+
+def test_kvlogger_warn_error_mirror_into_recorder():
+    rec = flight.default_recorder()
+    rec.clear()
+    log = kv_logger("bridge_test")
+    log.info("quiet", a=1)  # info is NOT mirrored
+    log.warn("warned", rid="r1", detail="x")
+    log.error("errored", code=7)
+    kinds = rec.counts()
+    assert "log.warn" in kinds and "log.error" in kinds
+    assert "log.info" not in kinds
+    w = rec.events(kind="log.warn")[0]
+    assert w.severity == "warn" and w.attrs["msg"] == "warned"
+    assert w.corr["rid"] == "r1"  # correlation keys routed to corr
+    assert w.attrs["detail"] == "x"
+    e = rec.events(kind="log.error")[0]
+    assert e.severity == "error" and e.attrs["code"] == 7
+
+
+# ---------------------------------------------------------------------------
+# /events endpoint
+
+
+def test_exporter_events_endpoint_with_filters():
+    rec = flight.default_recorder()
+    rec.clear()
+    rec.emit("e.one", rid="a")
+    rec.emit("e.two", rid="b", severity="warn")
+    rec.emit("e.one", rid="b")
+    with obs.MetricsExporter(obs.MetricsRegistry(), port=0) as exp:
+        raw = obs.scrape(exp.url, "/events")
+        recs = [json.loads(l) for l in raw.strip().splitlines()]
+        assert [r["kind"] for r in recs] == ["e.one", "e.two", "e.one"]
+        rid_b = obs.scrape(exp.url, "/events?rid=b")
+        assert all(
+            json.loads(l)["corr"]["rid"] == "b"
+            for l in rid_b.strip().splitlines()
+        )
+        one = obs.scrape(exp.url, "/events?kind=e.one&n=1")
+        (only,) = [json.loads(l) for l in one.strip().splitlines()]
+        assert only["kind"] == "e.one" and only["corr"]["rid"] == "b"
+        # /healthz advertises the endpoint
+        hz = json.loads(obs.scrape(exp.url, "/healthz"))
+        assert "/events" in hz["endpoints"]
+        # /trace carries the merged instant events
+        doc = json.loads(obs.scrape(exp.url, "/trace"))
+        assert {"e.one", "e.two"} <= {
+            e["name"] for e in doc["traceEvents"] if e["ph"] == "i"
+        }
+
+
+# ---------------------------------------------------------------------------
+# fleet event collection (worker-labeled union through coordinator KV)
+
+
+def test_metrics_pusher_publishes_events_window():
+    snaps, windows = [], []
+    rec = flight.FlightRecorder()
+    rec.emit("w.k", rid="r1")
+    p = obs.MetricsPusher(
+        snaps.append, interval_s=3600, registry=obs.MetricsRegistry(),
+        events_publish=windows.append, events_window=16, recorder=rec,
+    )
+    assert p.push_once()
+    assert len(snaps) == 1 and len(windows) == 1
+    # KV is a line protocol: the pushed window must be ONE line
+    assert "\n" not in windows[0]
+    (rec0,) = flight.load_jsonl(windows[0])
+    assert rec0["kind"] == "w.k"
+
+
+def test_collect_fleet_events_labels_by_worker():
+    from edl_tpu.runtime.coordinator import PyCoordinator
+
+    c = PyCoordinator()
+    c.register("w0", 1)
+    c.register("w1", 1)
+    r0 = flight.FlightRecorder(clock=lambda: 1.0)
+    r0.emit("a.k", rid="x")
+    r1 = flight.FlightRecorder(clock=lambda: 2.0)
+    r1.set_context(worker="w1-self")  # a stamped context wins
+    r1.emit("b.k")
+    c.kv_put(obs.events_key("job", "w0"), r0.window_json())
+    c.kv_put(obs.events_key("job", "w1"), r1.window_json())
+    c.kv_put(obs.events_key("job", "w2"), "{torn")  # skipped, not fatal
+    c.register("w2", 1)
+    merged = obs.collect_fleet_events(c, "job")
+    assert [(r["kind"], r["corr"]["worker"]) for r in merged] == [
+        ("a.k", "w0"), ("b.k", "w1-self"),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# postmortem analyzer (synthetic timelines)
+
+
+def _ev(seq, t, kind, severity="info", corr=None, attrs=None):
+    return {
+        "seq": seq, "t_wall": t, "t_mono": t, "kind": kind,
+        "severity": severity, "corr": corr or {}, "attrs": attrs or {},
+    }
+
+
+def _chain_events(broken=None):
+    evs = [
+        _ev(1, 0.0, "serve.submit", corr={"rid": "r1"}),
+        _ev(2, 0.1, "serve.prefill", corr={"rid": "r1"}),
+        _ev(3, 0.1, "serve.admit", corr={"rid": "r1"}),
+        _ev(4, 0.2, "fault.injected", "warn", {"site": "serve.dispatch"},
+            {"nth": 3, "action": "raise"}),
+        _ev(5, 0.3, "serve.recover", "warn", {},
+            {"rids": ["r1"], "requeued": None, "error": "InjectedFault"}),
+        _ev(6, 0.4, "serve.prefill", corr={"rid": "r1"},
+            attrs={"replay": True}),
+        _ev(7, 0.5, "serve.finish", corr={"rid": "r1"},
+            attrs={"outcome": "done", "tokens": 4}),
+    ]
+    if broken == "no_recover":
+        evs = [e for e in evs if e["kind"] != "serve.recover"]
+    elif broken == "no_replay":
+        evs = [e for e in evs if not (e["attrs"] or {}).get("replay")]
+    elif broken == "bad_outcome":
+        evs[-1]["attrs"]["outcome"] = "failed"
+    return evs
+
+
+def test_verify_recovered_accepts_complete_chain():
+    assert pm.verify_recovered(_chain_events()) == []
+    chains = pm.fault_chains(_chain_events())
+    assert len(chains) == 1 and chains[0]["ok"]
+    assert chains[0]["rids"][0] == {
+        "rid": "r1", "replayed": True, "outcome": "done"
+    }
+
+
+@pytest.mark.parametrize("broken", ["no_recover", "no_replay", "bad_outcome"])
+def test_verify_recovered_flags_broken_chains(broken):
+    problems = pm.verify_recovered(_chain_events(broken))
+    assert problems, broken
+
+
+def test_verify_recovered_requires_faults():
+    # a chaos dump whose faults never fired tested nothing
+    assert pm.verify_recovered([_ev(1, 0.0, "serve.submit")]) != []
+
+
+def test_verify_no_incidents():
+    clean = [
+        _ev(1, 0.0, "serve.submit", corr={"rid": "r"}),
+        _ev(2, 0.1, "serve.finish", corr={"rid": "r"},
+            attrs={"outcome": "done"}),
+    ]
+    assert pm.verify_no_incidents(clean) == []
+    assert pm.verify_no_incidents(_chain_events())  # fault + recovery
+    shed = clean + [_ev(3, 0.2, "serve.reject", "warn", {"rid": "s"},
+                        {"reason": "timeout", "shed": True})]
+    assert any("timeout" in p for p in pm.verify_no_incidents(shed))
+    err = clean + [_ev(4, 0.3, "log.error", "error", {}, {"msg": "bad"})]
+    assert any("error" in p for p in pm.verify_no_incidents(err))
+
+
+def test_render_report_timelines_and_gaps():
+    out = pm.render_report(_chain_events())
+    assert "fault -> recovery chains" in out and "[OK]" in out
+    assert "request r1" in out and "serve.finish" in out
+    # the reshard summary line
+    resh = [_ev(1, 0.0, "reshard.end", corr={"reshard_epoch": 0},
+                attrs={"from_workers": 2, "to_workers": 4,
+                       "stall_s": 1.5, "path": "device"})]
+    out2 = pm.render_report(resh)
+    assert "reshard_epoch=0" in out2 and "stall=1.5s" in out2
+
+
+def test_incidents_attach_follow_window():
+    inc = pm.incidents(_chain_events(), window_s=10.0)
+    (f,) = inc["faults"]
+    followed = [e["kind"] for e in f["followed"]]
+    assert "serve.recover" in followed and "serve.finish" in followed
+    assert len(inc["recoveries"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# the acceptance chain over a REAL engine crash + the CLI verb
+
+
+def _env():
+    return {
+        **os.environ,
+        "PYTHONPATH": os.path.dirname(os.path.dirname(__file__)),
+        "JAX_PLATFORMS": "cpu",
+    }
+
+
+def test_engine_crash_chain_and_postmortem_cli(tmp_path):
+    """End to end: injected dispatch fault -> engine recovery, the
+    flight recorder holds the causal chain, the analyzer verifies it
+    in-process, AND the `edl postmortem` CLI verifies the dumped file
+    (the run_tests.sh phase-6 contract)."""
+    from edl_tpu.models import llama
+    from edl_tpu.serving.engine import ContinuousBatchingEngine
+
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    rec = flight.default_recorder()
+    rec.clear()
+    faults.arm("serve.dispatch:raise@n=2", seed=0)
+    try:
+        eng = ContinuousBatchingEngine(
+            params, cfg, max_slots=2, max_len=64, horizon=4
+        )
+        eng.submit("x", [1, 2, 3], 8)
+        eng.submit("y", [4, 5, 6], 7)
+        res = eng.run()
+    finally:
+        faults.disarm()
+    assert eng.recoveries == 1
+    assert {r.outcome for r in res.values()} <= {"done", "eos"}
+    recs = rec.records()
+    assert pm.verify_recovered(recs) == []
+    (chain,) = pm.fault_chains(recs)
+    assert {r["rid"] for r in chain["rids"]} == {"x", "y"}
+    # every replayed rid shows a replay prefill between recover and finish
+    for rid in ("x", "y"):
+        kinds = [e["kind"] for e in recs
+                 if (e.get("corr") or {}).get("rid") == rid]
+        assert kinds.index("serve.finish") > kinds.index("serve.prefill")
+
+    dump = str(tmp_path / "chain.jsonl")
+    rec.dump(dump)
+    out = subprocess.run(
+        [sys.executable, "-m", "edl_tpu.cli", "postmortem", dump,
+         "--assert-recovered"],
+        capture_output=True, text=True, env=_env(),
+    )
+    assert out.returncode == 0, out.stderr
+    assert "postmortem assertions OK" in out.stdout
+    assert "fault -> recovery chains" in out.stdout and "[OK]" in out.stdout
+    # the same dump fails the no-incidents gate (it has a fault)
+    bad = subprocess.run(
+        [sys.executable, "-m", "edl_tpu.cli", "postmortem", dump,
+         "--assert-no-incidents"],
+        capture_output=True, text=True, env=_env(),
+    )
+    assert bad.returncode == 1 and "POSTMORTEM FAIL" in bad.stderr
+    # unreadable source -> exit 2
+    miss = subprocess.run(
+        [sys.executable, "-m", "edl_tpu.cli", "postmortem",
+         str(tmp_path / "nope.jsonl")],
+        capture_output=True, text=True, env=_env(),
+    )
+    assert miss.returncode == 2
+
+
+def test_recover_writes_blackbox_dump(tmp_path, monkeypatch):
+    """The engine's _recover is a black box: EDL_BLACKBOX_DIR gets the
+    ring BEFORE the rebuild, and the dump itself passes postmortem."""
+    from edl_tpu.models import llama
+    from edl_tpu.serving.engine import ContinuousBatchingEngine
+
+    bb = tmp_path / "bb"
+    monkeypatch.setenv("EDL_BLACKBOX_DIR", str(bb))
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    flight.default_recorder().clear()
+    faults.arm("serve.drain:raise@n=1", seed=0)
+    try:
+        eng = ContinuousBatchingEngine(params, cfg, max_slots=1, max_len=32)
+        eng.submit("a", [1, 2], 4)
+        res = eng.run()
+    finally:
+        faults.disarm()
+    assert res["a"].outcome == "done"
+    dumps = sorted(bb.glob("blackbox-serving-*.jsonl"))
+    assert dumps, "no black-box dump written"
+    loaded = flight.load_jsonl(str(dumps[0]))
+    kinds = [e["kind"] for e in loaded]
+    assert "fault.injected" in kinds and "serve.recover" in kinds
+
+
+def test_postmortem_loads_from_live_events_url():
+    rec = flight.default_recorder()
+    rec.clear()
+    rec.emit("live.k", rid="u1")
+    with obs.MetricsExporter(obs.MetricsRegistry(), port=0) as exp:
+        evs = pm.load_events(f"{exp.url}")
+        assert [e["kind"] for e in evs] == ["live.k"]
+        # a pasted .../events URL (what the exporter actually serves)
+        # must load too, with filters passed through to the endpoint
+        assert [e["kind"] for e in pm.load_events(f"{exp.url}/events")] == [
+            "live.k"
+        ]
+        # a filter that matches nothing keeps the empty-input guard:
+        # better a loud error than a silently empty postmortem
+        with pytest.raises(ValueError):
+            pm.load_events(f"{exp.url}/events?rid=nope")
+
+
+# ---------------------------------------------------------------------------
+# edl top incident strip (satellite)
+
+
+def test_top_incident_strip_from_event_counters():
+    from edl_tpu.obs.top import summarize
+
+    r = obs.MetricsRegistry()
+    # quiet endpoint: no strip
+    assert not any("INCIDENT" in l for l in summarize(
+        obs.parse_prometheus_text(r.render())
+    ))
+    r.counter("edl_serving_recoveries_total", "").inc(2)
+    r.counter("edl_faults_injected_total", "", ("site",)).inc(
+        3, site="serve.dispatch"
+    )
+    r.gauge("edl_worker_heartbeat_degraded", "").set(1)
+    r.counter("edl_events_dropped_total", "").inc(7)
+    r.counter("edl_events_total", "", ("kind",)).inc(4, kind="log.error")
+    fams = obs.parse_prometheus_text(r.render())
+    (strip,) = [l for l in summarize(fams) if l.startswith("INCIDENT")]
+    assert "recoveries=2" in strip
+    assert "faults_injected=3" in strip
+    assert "hb_degraded=1" in strip
+    assert "log_errors=4" in strip
+    assert "dropped_events=7" in strip

@@ -130,6 +130,67 @@ def test_label_escaping():
     assert v == 1 and labels["path"] == 'a"b\\c\nd'
 
 
+def test_label_escaping_edge_cases_round_trip():
+    """The pathological label values the ISSUE-5 satellite pins: a
+    literal backslash followed by ``n`` (the old chained-replace parser
+    turned the escaped backslash's tail into a newline), values ending
+    in quotes/backslashes, lone escapes, and multi-label lines where an
+    escaped quote must not terminate the value early."""
+    cases = [
+        "a\\nb",        # backslash + 'n' — NOT a newline
+        "a\nb",         # a real newline
+        'quote"end',
+        'end"',
+        "trail\\",
+        "\\",
+        '"',
+        'mix\\"x\nand"more\\\\',
+        "comma,inside",
+        "",
+    ]
+    r = obs.MetricsRegistry()
+    c = r.counter("edl_edge_total", "e", ("v", "other"))
+    for i, v in enumerate(cases):
+        c.inc(i + 1, v=v, other=f'p,"{i}\\')
+    parsed = obs.parse_prometheus_text(r.render())
+    got = {lv["v"]: (lv["other"], n) for lv, n in parsed["edl_edge_total"]}
+    for i, v in enumerate(cases):
+        assert v in got, f"case {i}: {v!r} lost in round trip: {sorted(got)}"
+        other, n = got[v]
+        assert other == f'p,"{i}\\' and n == i + 1, (v, other, n)
+
+
+def test_empty_histogram_renders_inf_bucket_and_nan_free_percentiles():
+    """An empty histogram still exposes its full cumulative schema
+    (+Inf bucket, sum, count, all zero) and every percentile surface
+    answers 0.0 — never NaN — through both the direct and the parsed
+    paths."""
+    import math
+
+    r = obs.MetricsRegistry()
+    h = r.histogram("edl_empty_seconds", "empty", buckets=(0.1, 1.0))
+    text = r.render()
+    assert 'edl_empty_seconds_bucket{le="+Inf"} 0' in text
+    assert "edl_empty_seconds_sum 0" in text
+    assert "edl_empty_seconds_count 0" in text
+    for q in (0.5, 0.95, 0.99):
+        direct = h.percentile(q)
+        assert direct == 0.0 and not math.isnan(direct)
+    parsed = obs.parse_prometheus_text(text)
+    for q in (0.5, 0.95, 0.99):
+        v = percentile_from_buckets(parsed["edl_empty_seconds_bucket"], q)
+        assert v == 0.0 and not math.isnan(v)
+    # no bucket samples at all (the degenerate consumer input)
+    assert percentile_from_buckets([], 0.99) == 0.0
+    # +Inf-only observations clamp to the largest finite edge
+    h.observe(50.0)
+    assert h.percentile(0.5) == 1.0
+    parsed = obs.parse_prometheus_text(r.render())
+    assert percentile_from_buckets(
+        parsed["edl_empty_seconds_bucket"], 0.5
+    ) == 1.0
+
+
 def test_parse_and_percentile_round_trip():
     r = obs.MetricsRegistry()
     h = r.histogram("edl_rt_seconds", "rt")

@@ -363,6 +363,97 @@ def test_interleave_policy_block_budget():
     assert InterleavePolicy(prefills_per_step=2).block_budget(8, 9, 4) == 8
 
 
+# -- timeout accounting (the ISSUE-5 double-count audit) ---------------------
+
+
+def test_timeout_shed_counts_once_as_rejected_never_completed():
+    """A queued request shed at pop counts exactly ONCE, as
+    rejected:timeout — never through on_finish, so `completed` and the
+    outcome counter stay untouched (the shed request was never
+    admitted)."""
+    from edl_tpu.obs.metrics import MetricsRegistry
+
+    t = [0.0]
+    eng = ContinuousBatchingEngine(
+        PARAMS, CFG, max_slots=1, max_len=64, clock=lambda: t[0],
+        metrics=ServingMetrics(clock=lambda: t[0],
+                               registry=MetricsRegistry()),
+    )
+    eng.submit("busy", [1, 2, 3], 6)  # occupies the only slot
+    eng.submit("stale", [4, 5, 6], 4, deadline_s=5.0)  # waits in queue
+    t[0] = 10.0  # deadline passes while queued
+    res = eng.run()
+    assert res["stale"].outcome == "timeout" and res["stale"].tokens == []
+    assert res["busy"].outcome == "done"
+    m = eng.metrics
+    assert m.rejected == {"timeout": 1}
+    # exactly once: completed counts ONLY the admitted request, and the
+    # outcome counter has no timeout entry (no on_finish for the shed)
+    assert m.completed == 1
+    assert m.outcomes == {"done": 1}
+    snap = m.snapshot()
+    assert snap["rejected_timeout"] == 1
+    assert "outcome_timeout" not in snap
+    # the registry twin agrees: 2 submitted, 1 rejected, 1 completed
+    assert m._m_requests.value(event="submitted") == 2
+    assert m._m_requests.value(event="rejected") == 1
+    assert m._m_requests.value(event="completed") == 1
+
+
+def test_timeout_eviction_counts_once_as_completed_never_rejected():
+    """An in-flight slot past its deadline counts exactly ONCE, as
+    completed{outcome=timeout} — never as a rejection — and keeps the
+    tokens drained so far."""
+    from edl_tpu.obs.metrics import MetricsRegistry
+
+    t = [0.0]
+    eng = ContinuousBatchingEngine(
+        PARAMS, CFG, max_slots=2, max_len=64, clock=lambda: t[0],
+        metrics=ServingMetrics(clock=lambda: t[0],
+                               registry=MetricsRegistry()),
+    )
+    eng.submit("slow", [1, 2, 3], 40, deadline_s=5.0)
+    eng.submit("ok", [4, 5, 6], 4)
+    for _ in range(3):
+        eng.step()
+    t[0] = 10.0  # slow's deadline passes mid-flight
+    res = eng.run()
+    assert res["slow"].outcome == "timeout"
+    assert 0 < len(res["slow"].tokens) < 40  # partial tokens kept
+    assert res["ok"].outcome == "done"
+    m = eng.metrics
+    assert m.rejected == {}  # never rejected:timeout for the evicted path
+    assert m.outcomes["timeout"] == 1 and m.completed == 2
+    assert m._m_requests.value(event="rejected") == 0
+    assert m._m_requests.value(event="completed") == 2
+
+
+def test_timeout_evicted_slot_reuse_leaks_no_stale_tokens():
+    """The audit's correctness half: a deadline eviction is host-only
+    (the device row keeps decoding), so a block dispatched BEFORE the
+    eviction still carries the old request's tokens in that lane. The
+    engine must drain those blocks before reusing the slot — the new
+    occupant's output stays token-identical to sequential generate."""
+    t = [0.0]
+    eng = ContinuousBatchingEngine(
+        PARAMS, CFG, max_slots=1, max_len=64, horizon=4,
+        clock=lambda: t[0],
+    )
+    eng.submit("old", [1, 2, 3], 20, deadline_s=5.0)
+    eng.step()  # old admitted; one horizon-4 block left in flight
+    assert eng._inflight
+    t[0] = 10.0  # old's deadline passes with the block undrained
+    eng.submit("new", [4, 5, 6], 6)
+    res = eng.run()
+    assert res["old"].outcome == "timeout"
+    assert res["new"].outcome == "done"
+    assert res["new"].tokens == _sequential([4, 5, 6], 6)
+    # accounting stayed exactly-once through the reuse
+    m = eng.metrics
+    assert m.outcomes == {"timeout": 1, "done": 1}
+    assert m.completed == 2 and m.rejected == {}
+
+
 # -- metrics + collector plumbing -------------------------------------------
 
 
