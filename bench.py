@@ -697,6 +697,72 @@ def _llama_serving_bench() -> dict:
     return out
 
 
+def _llama_goodput_bench() -> dict:
+    """SLO-goodput rung: a seeded bursty multi-tenant workload
+    (serving/loadgen.py — the same generator `edl loadgen` and the
+    soak harness use) replayed WALL-CLOCK against the engine, scored
+    by obs/slo.py. Publishes goodput req/s (requests meeting their
+    class TTFT+TPOT SLOs — the number a serving scheduler should be
+    judged by, per DistServe), TTFT SLO attainment, and the p99 queue
+    wait from the latency decomposition — the three figures the
+    ROADMAP's scheduler upgrades (priority classes, fairness,
+    preemption) must move."""
+    from edl_tpu.models import llama
+    from edl_tpu.obs import slo
+    from edl_tpu.obs.metrics import MetricsRegistry
+    from edl_tpu.serving import loadgen
+    from edl_tpu.serving.engine import ContinuousBatchingEngine
+    from edl_tpu.serving.metrics import ServingMetrics
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        cfg = flagship_decode_config()
+        n_requests, slots, max_len, rate = 48, 8, 256, 8.0
+    else:
+        cfg = llama.LlamaConfig.tiny(vocab=512)
+        n_requests, slots, max_len, rate = 16, 4, 96, 12.0
+    params = jax.jit(lambda: llama.init_params(jax.random.PRNGKey(4), cfg))()
+    if on_tpu:
+        params = jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.bfloat16), params
+        )
+    classes = slo.default_classes(1.0, 0.25)
+    spec = loadgen.WorkloadSpec(
+        seed=0, n_requests=n_requests, rate_rps=rate, arrival="burst",
+        vocab=cfg.vocab, classes=classes,
+    )
+    reqs = loadgen.build(spec)
+
+    def _run():
+        metrics = ServingMetrics(registry=MetricsRegistry())
+        eng = ContinuousBatchingEngine(
+            params, cfg, max_slots=slots, max_len=max_len, horizon=4,
+            metrics=metrics,
+        )
+        res = loadgen.replay(eng, reqs)
+        return slo.compute_goodput(
+            slo.request_records(metrics), spec.class_map(), res["wall_s"]
+        )
+
+    _run()  # pass 1 pays the jit compiles (block + prefill buckets)
+    report = _run()
+    out = {
+        "serving_goodput_rps": round(report["goodput_rps"], 2),
+        "serving_ttft_slo_attainment": round(
+            report["ttft_slo_attainment"], 4
+        ),
+        "serving_queue_wait_p99_s": round(
+            report["phases"]["queue_wait_s"]["p99"], 4
+        ),
+        "serving_goodput_config": (
+            f"slots{slots}/req{n_requests}/rate{rate:g}/{spec.arrival}"
+        ),
+    }
+    del params
+    jax.clear_caches()
+    return out
+
+
 def main() -> None:
     n_dev = len(jax.devices())
     plan = MeshPlan.data_parallel(n_dev)
@@ -816,6 +882,7 @@ def main() -> None:
     llama_metrics = _llama_flagship_bench(n_dev, plan, mesh, rng)
     llama_metrics.update(_llama_decode_bench())
     llama_metrics.update(_llama_serving_bench())
+    llama_metrics.update(_llama_goodput_bench())
     llama_metrics.update(_p2p_bench())
 
     print(

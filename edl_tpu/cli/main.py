@@ -662,9 +662,9 @@ def _read_serve_requests(
 ):
     """Parse the ``edl serve`` JSONL request feed (``-`` = stdin):
     one object per line, ``{"prompt": [ids], "id"?, "max_new"?,
-    "eos"?, "deadline_s"?}``. Returns a list of dicts or raises
-    ValueError — parsed BEFORE the export loads, so a malformed feed
-    never costs a multi-GB load."""
+    "eos"?, "deadline_s"?, "tenant"?, "slo_class"?}``. Returns a list
+    of dicts or raises ValueError — parsed BEFORE the export loads,
+    so a malformed feed never costs a multi-GB load."""
     if path == "-":
         lines = sys.stdin.read().splitlines()
     else:
@@ -688,6 +688,8 @@ def _read_serve_requests(
             raise ValueError(f"line {i + 1}: prompt must be a list of ints")
         eos = obj.get("eos", default_eos)
         dl = obj.get("deadline_s", default_deadline_s)
+        tenant = obj.get("tenant")
+        slo_class = obj.get("slo_class")
         out.append(
             {
                 "id": str(obj.get("id", f"req-{i + 1}")),
@@ -697,6 +699,10 @@ def _read_serve_requests(
                 "deadline_s": (
                     None if dl is None or float(dl) <= 0 else float(dl)
                 ),
+                # attribution labels: counted in the outcome metrics
+                # and stamped on flight-recorder submit/finish events
+                "tenant": None if tenant is None else str(tenant),
+                "slo_class": None if slo_class is None else str(slo_class),
             }
         )
     if not out:
@@ -794,7 +800,8 @@ def run_serve(args) -> int:
     for r in requests:
         try:
             engine.submit(r["id"], r["prompt"], r["max_new"], r["eos"],
-                          deadline_s=r["deadline_s"])
+                          deadline_s=r["deadline_s"],
+                          tenant=r["tenant"], slo_class=r["slo_class"])
         except AdmissionError as e:
             rejected[r["id"]] = e
             log.warn("request rejected", rid=r["id"], reason=e.reason)
@@ -824,6 +831,223 @@ def run_serve(args) -> int:
     print(collector.poll().render(), file=sys.stderr)
     if exporter is not None:
         exporter.stop()
+    return 0
+
+
+def _check_loadgen_scrape(exporter) -> None:
+    """The CI exposition contract for the loadgen lane
+    (scripts/run_tests.sh): after a dryrun load the scraped /metrics
+    must show the latency DECOMPOSITION histograms non-zero (queue
+    wait / prefill / block — the whole point of the measurement layer)
+    plus TPOT and the live SLO burn gauges."""
+    from edl_tpu import obs
+
+    text = obs.scrape(exporter.url)
+    fams = obs.parse_prometheus_text(text)
+
+    def total(series):
+        return sum(v for _, v in fams.get(series, ()))
+
+    for series in (
+        "edl_serving_queue_wait_seconds_count",
+        "edl_serving_prefill_seconds_count",
+        "edl_serving_block_seconds_count",
+        "edl_serving_tpot_seconds_count",
+    ):
+        assert total(series) > 0, f"{series} has no observations"
+    classes = [
+        labels.get("slo_class")
+        for labels, _ in fams.get("edl_slo_ttft_ok_ratio", ())
+        if labels.get("slo_class")
+    ]
+    assert classes, "no per-class edl_slo_ttft_ok_ratio gauges published"
+    assert total("edl_slo_ttft_ok_ratio") > 0, (
+        "TTFT SLO attainment is zero for every class — the dryrun "
+        "deadlines should be attainable on CPU"
+    )
+    out_n = sum(
+        v for labels, v in fams.get("edl_serving_outcomes_total", ())
+        if labels.get("tenant")
+    )
+    assert out_n > 0, "outcome counter carries no tenant labels"
+    print(
+        f"loadgen scrape OK: decomposition histograms non-zero, "
+        f"slo classes {sorted(set(classes))}",
+        file=sys.stderr,
+    )
+
+
+def run_loadgen(args) -> int:
+    """Generate a seeded arrival-process workload (serving/loadgen.py)
+    and replay it wall-clock against a live continuous-batching
+    engine, then report GOODPUT-UNDER-SLO (obs/slo.py): per-class
+    TTFT/ITL attainment, goodput req/s, shed/timeout accounting, and
+    the per-phase (queue-wait / prefill / decode) p50/p95/p99
+    breakdown. ``--dryrun`` serves a tiny randomly-initialized model
+    (the CI lane — no export needed); ``--workload-only`` generates
+    and writes the workload without touching a device (the
+    same-seed-byte-identical determinism check)."""
+    # argv-only validation first (same contract as run_serve)
+    if args.speed <= 0:
+        print(f"--speed must be > 0, got {args.speed}", file=sys.stderr)
+        return 1
+    if args.requests < 0:
+        print(f"--requests must be >= 0, got {args.requests}", file=sys.stderr)
+        return 1
+    if args.horizon < 1:
+        print(f"--horizon must be >= 1, got {args.horizon}", file=sys.stderr)
+        return 1
+    if args.ttft_slo <= 0 or args.itl_slo <= 0:
+        print("--ttft-slo/--itl-slo must be > 0", file=sys.stderr)
+        return 1
+    if not (args.dryrun or args.workload_only or args.export_dir):
+        print("error: need an EXPORT_DIR, --dryrun, or --workload-only",
+              file=sys.stderr)
+        return 1
+
+    from edl_tpu.obs import slo
+    from edl_tpu.serving import loadgen
+
+    auto_small = args.dryrun or args.workload_only
+    n_requests = args.requests or (16 if auto_small else 64)
+    rate = args.rate or (12.0 if auto_small else 4.0)
+    classes = slo.default_classes(args.ttft_slo, args.itl_slo)
+
+    params = cfg = None
+    if args.dryrun:
+        import jax
+
+        from edl_tpu.models import llama
+
+        cfg = llama.LlamaConfig.tiny(vocab=args.vocab)
+        params = jax.jit(
+            lambda: llama.init_params(jax.random.PRNGKey(1), cfg)
+        )()
+    elif not args.workload_only:
+        params, cfg_or_err = _load_llama_serving(
+            args.export_dir, args.mesh, args.int8
+        )
+        if params is None:
+            print(cfg_or_err, file=sys.stderr)
+            return 1
+        cfg = cfg_or_err
+
+    spec = loadgen.WorkloadSpec(
+        seed=args.seed,
+        n_requests=n_requests,
+        rate_rps=rate,
+        arrival=args.arrival,
+        burst_factor=args.burst_factor,
+        burst_dwell_s=args.burst_dwell_s,
+        vocab=cfg.vocab if cfg is not None else args.vocab,
+        classes=classes,
+    )
+    try:
+        reqs = loadgen.build(spec)
+    except ValueError as e:
+        print(f"bad workload spec: {e}", file=sys.stderr)
+        return 1
+    if args.workload_out:
+        with open(args.workload_out, "w") as f:
+            f.write(loadgen.workload_jsonl(reqs))
+        print(
+            f"# workload -> {args.workload_out} ({len(reqs)} requests)",
+            file=sys.stderr,
+        )
+    if args.workload_only:
+        print(json.dumps({
+            "requests": len(reqs), "seed": spec.seed,
+            "arrival": spec.arrival, "rate_rps": spec.rate_rps,
+            "span_s": round(reqs[-1].arrive_s, 6) if reqs else 0.0,
+        }))
+        return 0
+
+    slots = args.slots or (4 if args.dryrun else 8)
+    max_len = args.max_len or (96 if args.dryrun else 256)
+    need = loadgen.max_total_len(reqs)
+    if need > max_len:
+        print(
+            f"# NOTE: longest request needs {need} tokens > --max-len "
+            f"{max_len}; oversize requests will shed at admission",
+            file=sys.stderr,
+        )
+
+    from edl_tpu.obs.metrics import MetricsRegistry
+    from edl_tpu.serving.engine import ContinuousBatchingEngine
+    from edl_tpu.serving.metrics import ServingMetrics
+    from edl_tpu.serving.scheduler import AdmissionError
+
+    exporter = None
+    if args.metrics_port is not None:
+        from edl_tpu import obs
+
+        obs.bridge_tracer()
+        exporter = obs.start_exporter(port=args.metrics_port)
+        print(f"# metrics endpoint {exporter.url}/metrics", file=sys.stderr)
+
+    if not args.no_warmup:
+        # pay every jit compile (block program + the workload's prefill
+        # buckets) on a throwaway engine so the measured replay holds
+        # serving time, not compile time. The warm engine records into
+        # a PRIVATE registry — its traffic must not pollute /metrics.
+        warm = ContinuousBatchingEngine(
+            params, cfg, max_slots=slots, max_len=max_len,
+            horizon=args.horizon,
+            metrics=ServingMetrics(registry=MetricsRegistry()),
+        )
+        for r in reqs:
+            try:
+                warm.submit(r.rid, r.prompt, r.max_new)
+            except AdmissionError:
+                pass
+        warm.run()
+        del warm
+
+    metrics = ServingMetrics()
+    engine = ContinuousBatchingEngine(
+        params, cfg, max_slots=slots, max_len=max_len,
+        horizon=args.horizon, metrics=metrics,
+    )
+    cmap = spec.class_map()
+    t0 = time.monotonic()
+
+    def refresh_gauges():
+        # live burn-rate view: the exporter's SLO gauges track the
+        # run as it happens, not just the final report
+        slo.update_gauges(
+            slo.compute_goodput(
+                slo.request_records(metrics), cmap, time.monotonic() - t0
+            )
+        )
+
+    res = loadgen.replay(
+        engine, reqs, speed=args.speed,
+        on_tick=refresh_gauges if exporter is not None else None,
+    )
+    report = slo.compute_goodput(
+        slo.request_records(metrics), cmap, res["wall_s"]
+    )
+    report["steps"] = res["steps"]
+    report["workload"] = {
+        "seed": spec.seed, "arrival": spec.arrival,
+        "rate_rps": spec.rate_rps, "requests": len(reqs),
+        "speed": args.speed,
+    }
+    slo.update_gauges(report)
+    if args.dryrun and exporter is not None:
+        try:
+            _check_loadgen_scrape(exporter)
+        except AssertionError as e:
+            print(f"LOADGEN SCRAPE FAIL: {e}", file=sys.stderr)
+            if exporter is not None:
+                exporter.stop()
+            return 1
+    if exporter is not None:
+        exporter.stop()
+    if args.json:
+        print(json.dumps(report, sort_keys=True))
+    else:
+        print(slo.render_report(report))
     return 0
 
 
@@ -1230,6 +1454,102 @@ def build_parser() -> argparse.ArgumentParser:
         "bound URL prints on stderr)",
     )
     sv.set_defaults(fn=run_serve)
+
+    lg = sub.add_parser(
+        "loadgen",
+        help="replay a seeded arrival-process workload (Poisson / "
+        "Markov-modulated bursts, heavy-tailed lengths, multi-tenant "
+        "SLO classes) against the serving engine and report "
+        "goodput-under-SLO with a queue-wait/prefill/decode breakdown",
+    )
+    lg.add_argument(
+        "export_dir", nargs="?", default=None,
+        help="published llama export to serve (omit with --dryrun / "
+        "--workload-only)",
+    )
+    lg.add_argument(
+        "--dryrun", action="store_true",
+        help="serve a tiny randomly-initialized model instead of an "
+        "export — the CI lane (with --metrics-port it self-scrapes "
+        "and hard-asserts the decomposition histograms + SLO gauges)",
+    )
+    lg.add_argument(
+        "--workload-only", action="store_true",
+        help="generate + write the workload and exit without touching "
+        "a device (the same-seed byte-identity check)",
+    )
+    lg.add_argument("--seed", type=int, default=0)
+    lg.add_argument(
+        "--requests", type=int, default=0,
+        help="workload size (0 = auto: 16 dryrun, 64 export)",
+    )
+    lg.add_argument(
+        "--rate", type=float, default=0.0,
+        help="mean arrival rate, req/s (0 = auto: 12 dryrun, 4 export)",
+    )
+    lg.add_argument(
+        "--arrival", choices=["poisson", "burst", "fixed"],
+        default="burst",
+        help="arrival process (burst = 2-state Markov-modulated "
+        "Poisson: calm vs burst-factor x rate)",
+    )
+    lg.add_argument("--burst-factor", type=float, default=4.0)
+    lg.add_argument(
+        "--burst-dwell-s", type=float, default=1.0,
+        help="mean dwell per burst/calm state",
+    )
+    lg.add_argument(
+        "--speed", type=float, default=1.0,
+        help="replay-time multiplier (2.0 submits the same workload "
+        "twice as fast — overload knob)",
+    )
+    lg.add_argument(
+        "--ttft-slo", type=float, default=1.0,
+        help="interactive-class TTFT deadline, seconds (batch class "
+        "gets 8x)",
+    )
+    lg.add_argument(
+        "--itl-slo", type=float, default=0.25,
+        help="interactive-class per-token (TPOT) deadline, seconds "
+        "(batch class gets 4x)",
+    )
+    lg.add_argument(
+        "--vocab", type=int, default=512,
+        help="token-id space for --dryrun/--workload-only (exports "
+        "use the model's)",
+    )
+    lg.add_argument(
+        "--slots", type=int, default=0,
+        help="KV decode slots (0 = auto: 4 dryrun, 8 export)",
+    )
+    lg.add_argument(
+        "--max-len", type=int, default=0,
+        help="tokens per KV slot (0 = auto: 96 dryrun, 256 export)",
+    )
+    lg.add_argument("--horizon", type=int, default=4)
+    lg.add_argument(
+        "--no-warmup", action="store_true",
+        help="skip the compile-warmup pass (first requests then pay "
+        "jit compiles inside their measured prefill phase)",
+    )
+    lg.add_argument(
+        "--workload-out", default=None,
+        help="also write the generated workload as JSONL here "
+        "(byte-identical across same-seed runs)",
+    )
+    lg.add_argument(
+        "--json", action="store_true",
+        help="print the goodput report as one JSON object (CI)",
+    )
+    lg.add_argument(
+        "--metrics-port", type=int, default=None,
+        help="expose /metrics during the run with LIVE SLO burn "
+        "gauges (edl_slo_ttft_ok_ratio{slo_class}) refreshed every "
+        "few engine steps (0 = ephemeral)",
+    )
+    lg.add_argument("--mesh", default="", help="as in `edl serve`")
+    lg.add_argument("--int8", action="store_true", help="as in `edl serve`")
+    lg.set_defaults(fn=run_loadgen)
 
     pr = sub.add_parser(
         "predict",

@@ -135,6 +135,19 @@ class MonitorSample:
                 f"{s.get('itl_p95_s', 0.0) * 1e3:.1f}/"
                 f"{s.get('itl_p99_s', 0.0) * 1e3:.1f}ms"
             )
+        # the latency decomposition + TPOT (serving/metrics.py):
+        # absent on snapshots from engines predating them
+        if "queue_wait_p50_s" in s:
+            lines.append(
+                "  phases: queue_wait p50/p99="
+                f"{s.get('queue_wait_p50_s', 0.0) * 1e3:.1f}/"
+                f"{s.get('queue_wait_p99_s', 0.0) * 1e3:.1f}ms "
+                "prefill p50/p99="
+                f"{s.get('prefill_p50_s', 0.0) * 1e3:.1f}/"
+                f"{s.get('prefill_p99_s', 0.0) * 1e3:.1f}ms "
+                "tpot p50="
+                f"{s.get('tpot_p50_s', 0.0) * 1e3:.1f}ms"
+            )
         return lines
 
     def to_record(self) -> Dict:

@@ -46,3 +46,9 @@ from edl_tpu.obs.events import (  # noqa: F401
     crash_dump,
     default_recorder,
 )
+from edl_tpu.obs import slo  # noqa: F401  (goodput-under-SLO)
+from edl_tpu.obs.slo import (  # noqa: F401
+    SLOClass,
+    compute_goodput,
+    default_classes,
+)

@@ -466,6 +466,38 @@ def ensure_core_series(reg: Optional[MetricsRegistry] = None) -> MetricsRegistry
     r.counter("edl_serving_dispatch_total", "device program dispatches", ("kind",))
     r.histogram("edl_serving_ttft_seconds", "time to first token (submit -> first token)")
     r.histogram("edl_serving_itl_seconds", "inter-token latency (per generated token)")
+    r.histogram(
+        "edl_serving_tpot_seconds",
+        "user-perceived time per output token: (finish - first token) "
+        "/ (tokens - 1), once per finished request",
+    )
+    # the latency decomposition (queue wait + prefill ~= TTFT; block =
+    # the decode granule) — see doc/observability.md "SLO & goodput"
+    r.histogram("edl_serving_queue_wait_seconds", "queue wait (submit -> scheduler pop)")
+    r.histogram("edl_serving_prefill_seconds", "prefill phase (scheduler pop -> first token)")
+    r.histogram("edl_serving_block_seconds", "fused decode block wall time (dispatch -> drain)")
+    r.counter(
+        "edl_serving_outcomes_total",
+        "terminal request outcomes by tenant and SLO class",
+        ("outcome", "tenant", "slo_class"),
+    )
+    # SLO burn gauges (obs/slo.py update_gauges; loadgen refreshes
+    # them live during a load run)
+    r.gauge(
+        "edl_slo_ttft_ok_ratio",
+        "fraction of served requests meeting their class TTFT SLO",
+        ("slo_class",),
+    )
+    r.gauge(
+        "edl_slo_itl_ok_ratio",
+        "fraction of served requests meeting their class per-token SLO",
+        ("slo_class",),
+    )
+    r.gauge("edl_slo_goodput_rps", "requests/s finishing within their class SLOs")
+    r.gauge(
+        "edl_slo_goodput_fraction",
+        "good requests / all requests (shed and timeouts count against)",
+    )
     r.gauge("edl_serving_queue_depth", "requests waiting for a KV slot")
     r.gauge("edl_serving_active_slots", "occupied KV slots")
     r.gauge("edl_serving_slot_occupancy", "mean active/max slots over decode steps")

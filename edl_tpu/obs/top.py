@@ -63,16 +63,49 @@ def summarize(fams: _Fams) -> List[str]:
     if tokens or ttft_n:
         tp = _pctls(fams, "edl_serving_ttft_seconds")
         ip = _pctls(fams, "edl_serving_itl_seconds", (0.5,))
+        op = _pctls(fams, "edl_serving_tpot_seconds", (0.5,))
         disp = _total(fams, "edl_serving_dispatch_total")
         lines.append(
             f"SERVING  ttft p50/p95/p99={_ms(tp[0])}/{_ms(tp[1])}/{_ms(tp[2])} "
-            f"itl p50={_ms(ip[0])} tokens={tokens:.0f}"
+            f"itl p50={_ms(ip[0])} tpot p50={_ms(op[0])} tokens={tokens:.0f}"
         )
         lines.append(
             f"         queue={_total(fams, 'edl_serving_queue_depth'):.0f} "
             f"active_slots={_total(fams, 'edl_serving_active_slots'):.0f} "
             f"dispatches={disp:.0f}"
             + (f" disp/tok={disp / tokens:.3f}" if tokens else "")
+        )
+        # the TTFT decomposition, when the engine exports it: where
+        # the waiting actually happened (queue vs prefill vs block)
+        if _total(fams, "edl_serving_queue_wait_seconds_count"):
+            qw = _pctls(fams, "edl_serving_queue_wait_seconds", (0.5, 0.99))
+            pf = _pctls(fams, "edl_serving_prefill_seconds", (0.5, 0.99))
+            bl = _pctls(fams, "edl_serving_block_seconds", (0.5, 0.99))
+            lines.append(
+                f"         phases p50/p99: queue_wait={_ms(qw[0])}/{_ms(qw[1])} "
+                f"prefill={_ms(pf[0])}/{_ms(pf[1])} block={_ms(bl[0])}/{_ms(bl[1])}"
+            )
+
+    # SLO burn strip (obs/slo.py gauges; live during a loadgen run) —
+    # shown whenever any class has published an attainment ratio
+    slo_pairs = [
+        (labels.get("slo_class", "?"), v)
+        for labels, v in fams.get("edl_slo_ttft_ok_ratio", ())
+        if labels.get("slo_class")
+    ]
+    if slo_pairs:
+        itl_by_cls = {
+            labels.get("slo_class"): v
+            for labels, v in fams.get("edl_slo_itl_ok_ratio", ())
+        }
+        parts = [
+            f"{cls}: ttft_ok={v:.1%} itl_ok={itl_by_cls.get(cls, 0.0):.1%}"
+            for cls, v in sorted(slo_pairs)
+        ]
+        lines.append(
+            "SLO      " + "  ".join(parts)
+            + f"  goodput={_total(fams, 'edl_slo_goodput_rps'):.2f}/s"
+            f" ({_total(fams, 'edl_slo_goodput_fraction'):.1%} of offered)"
         )
 
     nre = _total(fams, "edl_reshard_total")

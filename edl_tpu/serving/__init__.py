@@ -1,9 +1,13 @@
 """Continuous-batching serving engine.
 
 ``scheduler`` (admission-controlled FIFO) and ``metrics`` (TTFT /
-tokens/s / occupancy) are jax-free and imported eagerly; the engine
-itself pulls in jax, so it loads lazily — control-plane code (the CLI's
-device-free verbs) can import this package without touching a device.
+tokens/s / occupancy / latency decomposition) are jax-free and
+imported eagerly; the engine itself pulls in jax, so it loads lazily —
+control-plane code (the CLI's device-free verbs) can import this
+package without touching a device. ``loadgen`` (seeded
+arrival-process workload generator + wall-clock replay, the SLO-
+goodput harness) is jax-free too but pulls numpy, so it stays a
+lazily-imported submodule (``from edl_tpu.serving import loadgen``).
 """
 
 from edl_tpu.serving.metrics import ServingMetrics

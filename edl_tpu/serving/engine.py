@@ -89,6 +89,15 @@ the process flight recorder (edl_tpu/obs/events.py) keyed by ``rid``,
 so ``edl postmortem`` reconstructs any request's timeline — and each
 ``_recover`` dumps the ring to ``$EDL_BLACKBOX_DIR`` (when set) before
 rebuilding, the black box that explains what led to the crash.
+
+**Latency decomposition.** The engine stamps each request's phases
+separately — queue wait ends at the scheduler pop (``on_pop``),
+prefill ends when the first token lands, and every fused block's
+dispatch→drain wall time is observed per drain (``on_block``) — so
+TTFT decomposes into "queue grew" vs "prefill slowed" and the
+``serve.finish`` event carries the full breakdown (plus the request's
+``tenant``/``slo_class`` labels); obs/slo.py turns the per-request
+records into goodput-under-SLO.
 """
 
 from __future__ import annotations
@@ -216,6 +225,8 @@ class _Slot:
     generated: List[int] = field(default_factory=list)
     deadline: Optional[float] = None
     recoveries: int = 0
+    tenant: Optional[str] = None
+    slo_class: Optional[str] = None
 
 
 @dataclass
@@ -341,10 +352,11 @@ class ContinuousBatchingEngine:
         # until every such block has drained (see _admit). A fresh
         # device state has no active rows — always starts empty.
         self._stale: set = set()
-        # dispatched-but-undrained block token matrices (device arrays);
-        # depth <= 2 transiently inside step(), <= 1 between steps —
-        # the double buffer
-        self._inflight: Deque[jax.Array] = deque()
+        # dispatched-but-undrained blocks as (token matrix, dispatch
+        # stamp) pairs — the stamp feeds the block-latency histogram
+        # at drain; depth <= 2 transiently inside step(), <= 1 between
+        # steps — the double buffer
+        self._inflight: Deque[tuple] = deque()
         # None until the first dispatch reveals whether this backend
         # honors donation (CPU/TPU do; a backend that copies instead
         # just loses the in-place win, not correctness)
@@ -359,14 +371,24 @@ class ContinuousBatchingEngine:
         max_new: int,
         eos_id: Optional[int] = None,
         deadline_s: Optional[float] = None,
+        *,
+        tenant: Optional[str] = None,
+        slo_class: Optional[str] = None,
     ) -> None:
         """Queue a request; raises :class:`AdmissionError` (and counts
         the rejection) when admission control refuses it. ``deadline_s``
         is a relative latency budget from now: past it the request is
-        shed from the queue or its slot evicted (outcome "timeout")."""
-        self.metrics.on_submit(rid)
+        shed from the queue or its slot evicted (outcome "timeout").
+        ``tenant``/``slo_class`` are attribution labels carried through
+        the outcome counters and flight-recorder events."""
+        self.metrics.on_submit(rid, tenant=tenant, slo_class=slo_class)
+        labels = {}
+        if tenant is not None:
+            labels["tenant"] = tenant
+        if slo_class is not None:
+            labels["slo_class"] = slo_class
         flight.emit("serve.submit", rid=rid, prompt_len=len(prompt),
-                    max_new=int(max_new))
+                    max_new=int(max_new), **labels)
         if rid in self.results or any(
             s is not None and s.rid == rid for s in self._slots
         ):
@@ -386,7 +408,8 @@ class ContinuousBatchingEngine:
             self.queue.submit(
                 Request(rid=rid, prompt=list(map(int, prompt)),
                         max_new=int(max_new), eos_id=eos_id,
-                        deadline_s=deadline_s)
+                        deadline_s=deadline_s, tenant=tenant,
+                        slo_class=slo_class)
             )
         except AdmissionError as e:
             self.metrics.on_reject(rid, e.reason)
@@ -530,7 +553,7 @@ class ContinuousBatchingEngine:
         # inputs are dead, the carries are rebound, and the block's
         # token matrix is about to be lost
         faults.fault_point("serve.dispatch")
-        self._inflight.append(toks)
+        self._inflight.append((toks, self.clock()))
 
     def _drain_one(self) -> int:
         """Sync the OLDEST in-flight block's [B, H] token matrix and
@@ -540,11 +563,14 @@ class ContinuousBatchingEngine:
         row at exactly the step the host would finish it, so the two
         views never disagree."""
         with tracing.span("serving.drain"):
-            blk = self._inflight.popleft()
+            blk, t_dispatch = self._inflight.popleft()
             # chaos site: the popped block is lost on a crash here —
             # its tokens exist only on device, recovery must regenerate
             faults.fault_point("serve.drain")
             out = np.asarray(blk)
+        # dispatch -> drained wall time: the decode-phase granule of
+        # the latency decomposition (end-to-end as the host saw it)
+        self.metrics.on_block(self.clock() - t_dispatch)
         emitted = 0
         for i in range(self.max_slots):
             sl = self._slots[i]
@@ -633,6 +659,9 @@ class ContinuousBatchingEngine:
                 break
             if self._shed_expired(req):
                 continue
+            # queue wait ends at the pop — from here the clock charges
+            # the prefill phase (the decomposition's first boundary)
+            self.metrics.on_pop(req.rid)
             slot = free.pop(0)
             # from here to the bookkeeping commit the request exists
             # only in this local — publish it so a prefill crash
@@ -657,6 +686,7 @@ class ContinuousBatchingEngine:
                 rid=req.rid, prompt=list(req.prompt), max_new=req.max_new,
                 eos_id=req.eos_id, generated=[tok0],
                 deadline=req.deadline_at(),
+                tenant=req.tenant, slo_class=req.slo_class,
             )
             self._slots[slot] = sl
             self._admitting = None
@@ -727,10 +757,23 @@ class ContinuousBatchingEngine:
             rid=sl.rid, tokens=list(sl.generated), outcome=outcome
         )
         self.metrics.on_finish(sl.rid, outcome)
+        # the finish event carries the phase decomposition (and the
+        # tenant/SLO labels), so a postmortem timeline shows WHERE the
+        # request's time went, not just when it ended
+        phases = {
+            k: round(v, 6)
+            for k, v in self.metrics.phase_breakdown(sl.rid).items()
+        }
+        labels = {}
+        if sl.tenant is not None:
+            labels["tenant"] = sl.tenant
+        if sl.slo_class is not None:
+            labels["slo_class"] = sl.slo_class
         flight.emit(
             "serve.finish",
             severity="info" if outcome in ("done", "eos") else "warn",
             rid=sl.rid, outcome=outcome, tokens=len(sl.generated),
+            **labels, **phases,
         )
         # eviction is bookkeeping only: the device already froze the
         # row (active mask), the freed cache row is dead weight until

@@ -1,5 +1,6 @@
-"""Serving metrics — TTFT/ITL latency histograms, tokens/s, queue
-depth, slot occupancy, request outcome counters.
+"""Serving metrics — TTFT/ITL/TPOT latency histograms, the
+queue-wait/prefill/block latency decomposition, tokens/s, queue
+depth, slot occupancy, and tenant/SLO-class-labeled outcome counters.
 
 The training side publishes load through ``monitor/collector.py`` so
 the autoscaler can act on it; serving publishes through the SAME
@@ -34,6 +35,8 @@ _LATENCY_BUCKETS = obs_metrics.DEFAULT_BUCKETS
 class _ReqRecord:
     has_submit: bool = False  # submit_s is meaningful (0.0 is a valid time)
     submit_s: float = 0.0
+    has_pop: bool = False  # pop_s is meaningful
+    pop_s: float = 0.0  # queue pop (queue-wait ends, prefill begins)
     admit_s: float = 0.0
     first_token_s: float = 0.0
     last_token_s: float = 0.0
@@ -41,6 +44,8 @@ class _ReqRecord:
     prompt_len: int = 0
     tokens: int = 0
     outcome: str = ""  # done | eos | rejected:<reason>
+    tenant: str = ""  # multi-tenant attribution ("" = unattributed)
+    slo_class: str = ""  # SLO class label ("" = unclassified)
 
 
 class ServingMetrics:
@@ -63,9 +68,29 @@ class ServingMetrics:
     read, n tokens). TTFT is NOT distorted by that batching — the
     first token always lands with the prefill at admission, which
     stays a synchronous :meth:`on_token`, so ``ttft_*`` measures
-    prefill latency, never block-drain latency. ITL under a block is
-    one weighted observation of the per-token mean across the drain
-    gap — exact in count and sum, bucketed at the mean."""
+    prefill latency, never block-drain latency.
+
+    **Honest tail ITL.** A drained block of n tokens lands as ONE
+    observation of the FULL inter-drain gap plus n-1 zeros — the user
+    actually waited the whole gap for the block's first token and got
+    the rest in the same drain. (The old per-token-mean bucketing kept
+    count and sum exact but hid every stall under the mean: at H=8 a
+    400 ms freeze bucketed as 8×50 ms and p99 ITL never saw it.)
+    Count and sum are unchanged, only the tail is truthful now. The
+    amortization-proof per-request figure is **TPOT** —
+    ``(finish − first token) / (tokens − 1)`` — observed once per
+    finished request into ``edl_serving_tpot_seconds``.
+
+    **Latency decomposition.** Each request's life splits into three
+    exactly-adjacent phases the engine stamps separately:
+    submit→pop (``edl_serving_queue_wait_seconds``, via
+    :meth:`on_pop`), pop→first token (``edl_serving_prefill_seconds``,
+    stamped when the first token lands), first token→finish (decode,
+    derivable; per drained block the dispatch→drain wall time lands in
+    ``edl_serving_block_seconds`` via :meth:`on_block`). The phases
+    sum to finish−submit per request (the tests/test_loadgen.py
+    invariant), so "TTFT regressed" decomposes into "queue grew" vs
+    "prefill got slower" instead of one conflated number."""
 
     def __init__(
         self,
@@ -103,6 +128,13 @@ class ServingMetrics:
             "engine crash-recovery passes (device state rebuilt, live "
             "slots re-prefilled from prompt + generated)",
         )
+        # terminal outcomes with tenant/SLO-class attribution — the
+        # counter a postmortem reads to answer "which tenant got shed"
+        self._m_outcomes = r.counter(
+            "edl_serving_outcomes_total",
+            "terminal request outcomes by tenant and SLO class",
+            ("outcome", "tenant", "slo_class"),
+        )
         # per-ENGINE histograms back the snapshot percentiles (several
         # engines may share the process registry; their union belongs
         # on /metrics, not in one engine's snapshot) …
@@ -111,6 +143,19 @@ class ServingMetrics:
         )
         self.itl_hist = obs_metrics.Histogram(
             "itl_s", "per-engine ITL", buckets=_LATENCY_BUCKETS
+        )
+        self.tpot_hist = obs_metrics.Histogram(
+            "tpot_s", "per-engine per-request TPOT", buckets=_LATENCY_BUCKETS
+        )
+        self.queue_wait_hist = obs_metrics.Histogram(
+            "queue_wait_s", "per-engine queue wait", buckets=_LATENCY_BUCKETS
+        )
+        self.prefill_hist = obs_metrics.Histogram(
+            "prefill_s", "per-engine prefill phase", buckets=_LATENCY_BUCKETS
+        )
+        self.block_hist = obs_metrics.Histogram(
+            "block_s", "per-engine block dispatch->drain",
+            buckets=_LATENCY_BUCKETS,
         )
         # … and the registry-resident twins are what the exporter
         # scrapes (identical bucket ladder, so the two views agree)
@@ -124,6 +169,27 @@ class ServingMetrics:
             "inter-token latency (per generated token)",
             buckets=_LATENCY_BUCKETS,
         )
+        self._r_tpot = r.histogram(
+            "edl_serving_tpot_seconds",
+            "user-perceived time per output token: (finish - first "
+            "token) / (tokens - 1), once per finished request",
+            buckets=_LATENCY_BUCKETS,
+        )
+        self._r_queue_wait = r.histogram(
+            "edl_serving_queue_wait_seconds",
+            "queue wait (submit -> scheduler pop)",
+            buckets=_LATENCY_BUCKETS,
+        )
+        self._r_prefill = r.histogram(
+            "edl_serving_prefill_seconds",
+            "prefill phase (scheduler pop -> first token)",
+            buckets=_LATENCY_BUCKETS,
+        )
+        self._r_block = r.histogram(
+            "edl_serving_block_seconds",
+            "fused decode block wall time (dispatch -> drain)",
+            buckets=_LATENCY_BUCKETS,
+        )
         self._m_queue = r.gauge(
             "edl_serving_queue_depth", "requests waiting for a KV slot"
         )
@@ -134,9 +200,17 @@ class ServingMetrics:
 
     # -- engine hooks -------------------------------------------------------
 
-    def on_submit(self, rid: str) -> None:
+    def on_submit(
+        self,
+        rid: str,
+        tenant: Optional[str] = None,
+        slo_class: Optional[str] = None,
+    ) -> None:
         self.submitted += 1
-        self.requests[rid] = _ReqRecord(has_submit=True, submit_s=self.clock())
+        self.requests[rid] = _ReqRecord(
+            has_submit=True, submit_s=self.clock(),
+            tenant=tenant or "", slo_class=slo_class or "",
+        )
         self._m_requests.inc(event="submitted")
 
     def on_reject(self, rid: str, reason: str) -> None:
@@ -146,6 +220,24 @@ class ServingMetrics:
         )
         rec.outcome = f"rejected:{reason}"
         self._m_requests.inc(event="rejected")
+        self._m_outcomes.inc(
+            outcome=f"rejected:{reason}",
+            tenant=rec.tenant, slo_class=rec.slo_class,
+        )
+
+    def on_pop(self, rid: str) -> None:
+        """The scheduler handed this request to the engine: queue wait
+        ends here, the prefill phase begins. (A crash-recovery requeue
+        pops again — the LAST pop wins, so queue wait includes the
+        re-queued time, which is what the user experienced.)"""
+        now = self.clock()
+        rec = self.requests.setdefault(rid, _ReqRecord())
+        rec.pop_s = now
+        rec.has_pop = True
+        if rec.has_submit:
+            w = now - rec.submit_s
+            self.queue_wait_hist.observe(w)
+            self._r_queue_wait.observe(w)
 
     def on_admit(self, rid: str, prompt_len: int) -> None:
         self.admitted += 1
@@ -172,15 +264,28 @@ class ServingMetrics:
                 ttft = now - rec.submit_s
                 self.ttft_hist.observe(ttft)
                 self._r_ttft.observe(ttft)
+            if rec.has_pop:
+                pf = now - rec.pop_s
+                self.prefill_hist.observe(pf)
+                self._r_prefill.observe(pf)
             if n > 1:
                 # tokens beyond the first in the same drain: zero
                 # observable inter-token gap at this clock resolution
                 self.itl_hist.observe(0.0, n=n - 1)
                 self._r_itl.observe(0.0, n=n - 1)
         elif rec.last_token_s:
-            itl = (now - rec.last_token_s) / n
-            self.itl_hist.observe(itl, n=n)
-            self._r_itl.observe(itl, n=n)
+            # honest tail: the user waited the FULL inter-drain gap
+            # for this block's first token; the other n-1 arrived in
+            # the same drain. One full-gap observation + n-1 zeros
+            # keeps count and sum identical to the old per-token-mean
+            # bucketing while letting p99 see the stall (a mean of
+            # gap/n hid every block-sized freeze as H grew).
+            gap = now - rec.last_token_s
+            self.itl_hist.observe(gap)
+            self._r_itl.observe(gap)
+            if n > 1:
+                self.itl_hist.observe(0.0, n=n - 1)
+                self._r_itl.observe(0.0, n=n - 1)
         rec.last_token_s = now
         rec.tokens += n
         self.tokens_out += n
@@ -192,6 +297,16 @@ class ServingMetrics:
         block, ``prefill`` = an admission insert)."""
         self.dispatches[kind] += 1
         self._m_dispatch.inc(kind=kind)
+
+    def on_block(self, seconds: float) -> None:
+        """One fused horizon block's dispatch→drain wall time — the
+        decode-phase granule. Under the double-buffered pipeline a
+        block's drain overlaps the NEXT block's device work, so this
+        is end-to-end block latency as the host observed it, not pure
+        device time (that is what makes it the right number for SLO
+        accounting)."""
+        self.block_hist.observe(seconds)
+        self._r_block.observe(seconds)
 
     def on_recovery(self, live_slots: int) -> None:
         """One engine recovery pass: in-flight blocks discarded, device
@@ -205,7 +320,16 @@ class ServingMetrics:
         rec = self.requests.setdefault(rid, _ReqRecord())
         rec.outcome = outcome
         rec.finish_s = self.clock()
+        if rec.tokens >= 2 and rec.first_token_s:
+            # user-perceived TPOT over the whole decode: block
+            # amortization cannot hide a stall from this one
+            tpot = (rec.finish_s - rec.first_token_s) / (rec.tokens - 1)
+            self.tpot_hist.observe(tpot)
+            self._r_tpot.observe(tpot)
         self._m_requests.inc(event="completed")
+        self._m_outcomes.inc(
+            outcome=outcome, tenant=rec.tenant, slo_class=rec.slo_class
+        )
 
     def on_step(self, active_slots: int, max_slots: int, queue_depth: int):
         """One engine iteration (decode step or idle-admit pass)."""
@@ -237,6 +361,35 @@ class ServingMetrics:
             "tokens": rec.tokens,
             "tokens_per_s": rec.tokens / dur if dur > 0 else 0.0,
             "outcome": rec.outcome,
+        }
+
+    def phase_breakdown(self, rid: str) -> Dict[str, float]:
+        """One request's latency decomposition — queue wait (submit →
+        pop), prefill (pop → first token), decode (first token →
+        finish), total (submit → finish). The three phases are
+        exactly adjacent stamps of one clock, so
+        ``queue_wait + prefill + decode == total`` for any finished
+        request. Zeros where a phase never happened (e.g. shed before
+        pop). Attached to the flight-recorder ``serve.finish`` event
+        by the engine, so `edl postmortem` shows WHERE the time went."""
+        rec = self.requests.get(rid)
+        if rec is None:
+            return {"queue_wait_s": 0.0, "prefill_s": 0.0,
+                    "decode_s": 0.0, "total_s": 0.0}
+        end = rec.finish_s or self.clock()
+        return {
+            "queue_wait_s": (
+                rec.pop_s - rec.submit_s
+                if rec.has_submit and rec.has_pop else 0.0
+            ),
+            "prefill_s": (
+                rec.first_token_s - rec.pop_s
+                if rec.has_pop and rec.first_token_s else 0.0
+            ),
+            "decode_s": (
+                end - rec.first_token_s if rec.first_token_s else 0.0
+            ),
+            "total_s": end - rec.submit_s if rec.has_submit else 0.0,
         }
 
     def snapshot(self) -> Dict[str, float]:
@@ -280,6 +433,21 @@ class ServingMetrics:
             "itl_p50_s": self.itl_hist.percentile(0.50),
             "itl_p95_s": self.itl_hist.percentile(0.95),
             "itl_p99_s": self.itl_hist.percentile(0.99),
+            "tpot_p50_s": self.tpot_hist.percentile(0.50),
+            "tpot_p95_s": self.tpot_hist.percentile(0.95),
+            "tpot_p99_s": self.tpot_hist.percentile(0.99),
+            # the TTFT decomposition (queue wait + prefill ≈ TTFT):
+            # "TTFT regressed" resolves into "queue grew" vs "prefill
+            # slowed" from the snapshot alone
+            "queue_wait_p50_s": self.queue_wait_hist.percentile(0.50),
+            "queue_wait_p95_s": self.queue_wait_hist.percentile(0.95),
+            "queue_wait_p99_s": self.queue_wait_hist.percentile(0.99),
+            "prefill_p50_s": self.prefill_hist.percentile(0.50),
+            "prefill_p95_s": self.prefill_hist.percentile(0.95),
+            "prefill_p99_s": self.prefill_hist.percentile(0.99),
+            "block_p50_s": self.block_hist.percentile(0.50),
+            "block_p95_s": self.block_hist.percentile(0.95),
+            "block_p99_s": self.block_hist.percentile(0.99),
             "agg_tokens_per_s": self.tokens_out / busy if busy > 0 else 0.0,
             "dispatches_decode": float(self.dispatches["decode"]),
             "dispatches_prefill": float(self.dispatches["prefill"]),
@@ -296,4 +464,20 @@ class ServingMetrics:
             snap[f"rejected_{reason}"] = float(n)
         for outcome, n in sorted(self.outcomes.items()):
             snap[f"outcome_{outcome}"] = float(n)
+        # tenant / SLO-class attribution: terminal outcomes per label
+        # (the flat-dict twin of edl_serving_outcomes_total — what a
+        # label-blind ServingSource consumer still gets to see)
+        by_class: Counter = Counter()
+        by_tenant: Counter = Counter()
+        for rec in self.requests.values():
+            if not rec.outcome:
+                continue
+            if rec.slo_class:
+                by_class[rec.slo_class] += 1
+            if rec.tenant:
+                by_tenant[rec.tenant] += 1
+        for name, n in sorted(by_class.items()):
+            snap[f"class_{name}_finished"] = float(n)
+        for name, n in sorted(by_tenant.items()):
+            snap[f"tenant_{name}_finished"] = float(n)
         return snap

@@ -30,7 +30,14 @@ class Request:
     latency budget from submit: past it, the engine sheds the request
     from the queue (``rejected:timeout``) or evicts its slot between
     blocks (outcome "timeout") — overload degrades by dropping the
-    stalest work, never by growing the queue without bound."""
+    stalest work, never by growing the queue without bound.
+
+    ``tenant`` and ``slo_class`` are attribution labels (None = the
+    single-tenant/SLO-less feed): the metrics layer counts terminal
+    outcomes under them (``edl_serving_outcomes_total``) and the
+    flight-recorder submit/finish events carry them, so a postmortem
+    can answer "which tenant got shed" — the label plumbing every
+    fairness/priority scheduler upgrade will route decisions by."""
 
     rid: str
     prompt: List[int]
@@ -39,6 +46,8 @@ class Request:
     deadline_s: Optional[float] = None
     submit_s: float = 0.0  # stamped by the queue at admission
     recoveries: int = 0  # engine crash-recovery passes charged while queued
+    tenant: Optional[str] = None  # multi-tenant attribution
+    slo_class: Optional[str] = None  # SLO class (obs/slo.py)
 
     def deadline_at(self) -> Optional[float]:
         """Absolute deadline on the queue's clock, or None."""

@@ -65,25 +65,23 @@ def build_workload(n_requests, vocab, rng, on_tpu, deep=False):
     """Mixed-length prompts/budgets + step-indexed arrivals. ``deep``
     builds the decode-heavy variant for the horizon sweep (long
     budgets, short prompts — dispatch amortization only shows when
-    blocks run full)."""
-    reqs = []
-    step = 0
-    for i in range(n_requests):
-        if deep:
-            t0 = int(rng.randint(16, 64) if on_tpu else rng.randint(3, 8))
-            max_new = int(rng.randint(128, 192) if on_tpu
-                          else rng.randint(64, 80))
-        else:
-            t0 = int(rng.randint(12, 96) if on_tpu else rng.randint(3, 14))
-            max_new = int(rng.randint(16, 48) if on_tpu else rng.randint(4, 12))
-        prompt = rng.randint(0, vocab, t0).tolist()
-        reqs.append(
-            {"rid": f"r{i}", "prompt": prompt, "max_new": max_new,
-             "arrive": step}
-        )
-        # bursty arrivals: some requests land together, some trickle
-        step += int(rng.randint(0, 4))
-    return reqs
+    blocks run full). The generator proper lives in
+    ``edl_tpu/serving/loadgen.py`` (shared with ``bench.py`` and
+    `edl loadgen`, so the three load surfaces cannot drift apart);
+    this wrapper just picks the platform-sized ranges. Draw order is
+    pinned there, so these are the same bytes pre-refactor runs saw."""
+    from edl_tpu.serving import loadgen
+
+    if deep:
+        prompt_range = (16, 64) if on_tpu else (3, 8)
+        max_new_range = (128, 192) if on_tpu else (64, 80)
+    else:
+        prompt_range = (12, 96) if on_tpu else (3, 14)
+        max_new_range = (16, 48) if on_tpu else (4, 12)
+    return loadgen.step_indexed_workload(
+        n_requests, vocab, rng,
+        prompt_range=prompt_range, max_new_range=max_new_range,
+    )
 
 
 def run_workload(params, cfg, reqs, max_slots, max_len, horizon=1):
@@ -184,6 +182,16 @@ def check_scrape(exporter) -> None:
     assert total("edl_serving_dispatch_total", kind="prefill") > 0
     assert "edl_serving_queue_depth" in fams, "queue gauge missing"
     assert total("edl_serving_itl_seconds_count") > 0, "ITL histogram empty"
+    # the latency decomposition + TPOT series the SLO layer consumes
+    # (queue wait at pop, prefill at first token, block per drain,
+    # TPOT per finished multi-token request) must all have fired
+    for name in (
+        "edl_serving_queue_wait_seconds_count",
+        "edl_serving_prefill_seconds_count",
+        "edl_serving_block_seconds_count",
+        "edl_serving_tpot_seconds_count",
+    ):
+        assert total(name) > 0, f"{name} has no observations"
     # the full catalog renders even on a serving-only process:
     # unlabeled training/reshard series as zero-valued samples,
     # labeled families at least as schema (TYPE) lines

@@ -82,6 +82,40 @@ done
 rm -rf "$EVDIR"
 t6=$(date +%s)
 echo "== phase 6 done in $((t6 - t5))s (rc=$rc6) =="
-echo "== total $((t6 - t0))s =="
 
-[ "$rc1" -eq 0 ] && [ "$rc2" -eq 0 ] && [ "$rc3" -eq 0 ] && [ "$rc4" -eq 0 ] && [ "$rc5" -eq 0 ] && [ "$rc6" -eq 0 ]
+echo "== phase 7: SLO loadgen dryrun (workload determinism + goodput telemetry) =="
+# the goodput measurement layer, end to end: `edl loadgen --dryrun`
+# replays a seeded bursty multi-tenant workload against a live tiny
+# engine, self-scrapes its own /metrics, and hard-asserts the latency
+# DECOMPOSITION histograms (queue-wait / prefill / block) + TPOT +
+# the per-class SLO burn gauges are present and non-zero. Then a
+# second same-seed run must produce a BYTE-IDENTICAL workload file
+# (cmp) — the determinism contract CI pins. Finally the JSON report
+# must carry goodput + the per-phase p50/p95/p99 breakdown.
+LGDIR="${TMPDIR:-/tmp}/edl-loadgen.$$"
+rm -rf "$LGDIR"; mkdir -p "$LGDIR"
+rc7=0
+JAX_PLATFORMS=cpu python -m edl_tpu.cli loadgen --dryrun --seed 0 --json \
+    --metrics-port 0 --workload-out "$LGDIR/w1.jsonl" \
+    > "$LGDIR/report.json" || rc7=1
+python -m edl_tpu.cli loadgen --dryrun --seed 0 --workload-only \
+    --workload-out "$LGDIR/w2.jsonl" > /dev/null || rc7=1
+cmp -s "$LGDIR/w1.jsonl" "$LGDIR/w2.jsonl" \
+    || { echo "same-seed loadgen workloads are NOT byte-identical"; rc7=1; }
+python - "$LGDIR/report.json" <<'PY' || rc7=1
+import json, sys
+r = json.load(open(sys.argv[1]))
+assert r["requests"] > 0 and "goodput_rps" in r, "no goodput in report"
+for ph in ("queue_wait_s", "prefill_s", "decode_s"):
+    for q in ("p50", "p95", "p99"):
+        assert q in r["phases"][ph], f"missing {ph}.{q}"
+assert r["classes"], "no per-class SLO accounting"
+print(f"loadgen report OK: goodput={r['goodput_rps']:.2f} req/s "
+      f"ttft_attainment={r['ttft_slo_attainment']:.1%}")
+PY
+rm -rf "$LGDIR"
+t7=$(date +%s)
+echo "== phase 7 done in $((t7 - t6))s (rc=$rc7) =="
+echo "== total $((t7 - t0))s =="
+
+[ "$rc1" -eq 0 ] && [ "$rc2" -eq 0 ] && [ "$rc3" -eq 0 ] && [ "$rc4" -eq 0 ] && [ "$rc5" -eq 0 ] && [ "$rc6" -eq 0 ] && [ "$rc7" -eq 0 ]

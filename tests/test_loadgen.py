@@ -1,0 +1,464 @@
+"""SLO-goodput load harness (serving/loadgen.py + obs/slo.py + the
+ServingMetrics latency decomposition).
+
+All jax-free: the generator, the goodput math, and the decomposition
+are host bookkeeping driven by injectable clocks — a test failure
+here is an accounting bug, never a device flake. The real-engine end
+of the harness is CI-covered by `edl loadgen --dryrun` (run_tests.sh
+phase 7) and the exp_serving scrape lane.
+"""
+
+import json
+import math
+
+import numpy as np
+import pytest
+
+from edl_tpu.obs import slo
+from edl_tpu.obs.metrics import MetricsRegistry
+from edl_tpu.serving import loadgen
+from edl_tpu.serving.metrics import ServingMetrics
+from edl_tpu.serving.scheduler import AdmissionError, Request, RequestQueue
+
+
+def _metrics(t):
+    """A ServingMetrics on a fake clock and a PRIVATE registry (no
+    cross-test pollution through the process default)."""
+    return ServingMetrics(clock=lambda: t[0], registry=MetricsRegistry())
+
+
+# -- generator determinism ---------------------------------------------------
+
+
+@pytest.mark.parametrize("arrival", ["poisson", "burst", "fixed"])
+def test_same_seed_byte_identical(arrival):
+    """The CI contract: same spec => byte-identical workload bytes;
+    a different seed diverges."""
+    spec = loadgen.WorkloadSpec(
+        seed=7, n_requests=40, rate_rps=20.0, arrival=arrival
+    )
+    a = loadgen.workload_jsonl(loadgen.build(spec))
+    b = loadgen.workload_jsonl(loadgen.build(spec))
+    assert a == b
+    other = loadgen.WorkloadSpec(
+        seed=8, n_requests=40, rate_rps=20.0, arrival=arrival
+    )
+    assert loadgen.workload_jsonl(loadgen.build(other)) != a
+
+
+def test_workload_shape_and_bounds():
+    spec = loadgen.WorkloadSpec(seed=0, n_requests=64, rate_rps=16.0)
+    reqs = loadgen.build(spec)
+    assert len(reqs) == 64
+    cmap = spec.class_map()
+    tenants = {t.name: t for t in spec.tenants}
+    arrive = [r.arrive_s for r in reqs]
+    assert arrive == sorted(arrive) and arrive[0] >= 0.0
+    for r in reqs:
+        t = tenants[r.tenant]
+        assert 1 <= len(r.prompt) <= t.prompt_max
+        assert 1 <= r.max_new <= t.output_max
+        assert all(0 <= tok < spec.vocab for tok in r.prompt)
+        # the SLO contract is stamped onto the request itself
+        assert r.slo_class == t.slo_class
+        assert r.ttft_slo_s == cmap[t.slo_class].ttft_slo_s
+    # every line parses back and carries the labels
+    for line in loadgen.workload_jsonl(reqs).splitlines():
+        rec = json.loads(line)
+        assert rec["tenant"] in tenants and rec["slo_class"] in cmap
+
+
+def test_fixed_arrivals_are_evenly_spaced():
+    spec = loadgen.WorkloadSpec(
+        seed=3, n_requests=10, rate_rps=4.0, arrival="fixed"
+    )
+    reqs = loadgen.build(spec)
+    gaps = [
+        round(b.arrive_s - a.arrive_s, 6)
+        for a, b in zip(reqs, reqs[1:])
+    ]
+    assert gaps == [pytest.approx(0.25)] * 9
+
+
+def test_burst_mean_rate_is_preserved():
+    """The MMPP redistributes arrivals into bursts but must not change
+    the long-run offered load."""
+    spec = loadgen.WorkloadSpec(
+        seed=1, n_requests=4000, rate_rps=50.0, arrival="burst",
+        burst_factor=6.0, burst_dwell_s=0.5,
+    )
+    reqs = loadgen.build(spec)
+    span = reqs[-1].arrive_s
+    rate = len(reqs) / span
+    assert rate == pytest.approx(50.0, rel=0.15)
+    # and it actually bursts: inter-arrival variance well above the
+    # exponential's (CV > 1 is the definition of bursty)
+    gaps = np.diff([r.arrive_s for r in reqs])
+    cv = float(np.std(gaps) / np.mean(gaps))
+    assert cv > 1.1, f"burst arrivals look Poisson (cv={cv:.2f})"
+
+
+def test_bad_specs_raise():
+    with pytest.raises(ValueError):
+        loadgen.build(loadgen.WorkloadSpec(rate_rps=0.0))
+    with pytest.raises(ValueError):
+        loadgen.build(loadgen.WorkloadSpec(arrival="nope"))
+    with pytest.raises(ValueError):
+        loadgen.build(
+            loadgen.WorkloadSpec(
+                tenants=(loadgen.TenantSpec("x", slo_class="missing"),)
+            )
+        )
+
+
+def test_step_indexed_workload_matches_legacy_draws():
+    """The soak/bench builder moved here verbatim: same RandomState,
+    same draw order, same bytes as the pre-refactor exp_serving code
+    (the dispatch-bound CI assertions were tuned on these)."""
+    rng1 = np.random.RandomState(5)
+    got = loadgen.step_indexed_workload(
+        6, 512, rng1, prompt_range=(3, 8), max_new_range=(64, 80)
+    )
+    rng2 = np.random.RandomState(5)
+    step = 0
+    for i, g in enumerate(got):
+        t0 = int(rng2.randint(3, 8))
+        max_new = int(rng2.randint(64, 80))
+        prompt = rng2.randint(0, 512, t0).tolist()
+        assert g == {"rid": f"r{i}", "prompt": prompt,
+                     "max_new": max_new, "arrive": step}
+        step += int(rng2.randint(0, 4))
+
+
+# -- wall-clock replay (fake engine, fake clock) -----------------------------
+
+
+class _FakeEngine:
+    """Minimal engine double: admission-bounded queue, fixed per-step
+    service, the same submit/step/has_work surface replay() drives."""
+
+    def __init__(self, clock, depth=4, steps_per_req=2):
+        self.clock = clock
+        self.queue = RequestQueue(max_total_len=64, max_depth=depth,
+                                  clock=clock)
+        self.steps_per_req = steps_per_req
+        self.submits = []
+        self.served = []
+        self._work = 0
+
+    @property
+    def has_work(self):
+        return self.queue.depth > 0 or self._work > 0
+
+    def submit(self, rid, prompt, max_new, tenant=None, slo_class=None):
+        self.submits.append((rid, self.clock()))
+        self.queue.submit(Request(rid=rid, prompt=list(prompt),
+                                  max_new=max_new, tenant=tenant,
+                                  slo_class=slo_class))
+
+    def step(self):
+        if self._work == 0 and self.queue.depth:
+            self.queue.pop()
+            self._work = self.steps_per_req
+        if self._work:
+            self._work -= 1
+            if self._work == 0:
+                self.served.append(self.clock())
+
+
+def test_replay_paces_submissions_on_the_wall_clock():
+    t = [0.0]
+
+    def clock():
+        return t[0]
+
+    def sleep(dt):
+        t[0] += max(dt, 1e-4)
+
+    fake = _FakeEngine(clock)
+    orig_step = fake.step
+
+    def step():
+        t[0] += 0.01  # each engine step costs 10 ms of fake wall time
+        orig_step()
+
+    fake.step = step
+    spec = loadgen.WorkloadSpec(
+        seed=2, n_requests=8, rate_rps=5.0, arrival="fixed"
+    )
+    reqs = loadgen.build(spec)
+    res = loadgen.replay(fake, reqs, clock=clock, sleep=sleep)
+    assert res["submitted"] == 8 and res["rejected"] == 0
+    assert len(fake.served) == 8
+    # nothing submitted before its arrival offset
+    by_rid = {r.rid: r.arrive_s for r in reqs}
+    for rid, at in fake.submits:
+        assert at >= by_rid[rid] - 1e-9
+    assert res["wall_s"] >= reqs[-1].arrive_s
+
+
+def test_replay_counts_shed_load_instead_of_dying():
+    t = [0.0]
+    clock = lambda: t[0]  # noqa: E731
+    sleep = lambda dt: t.__setitem__(0, t[0] + max(dt, 1e-4))  # noqa: E731
+    fake = _FakeEngine(clock, depth=1, steps_per_req=50)
+    orig_step = fake.step
+
+    def step():
+        t[0] += 0.01
+        orig_step()
+
+    fake.step = step
+    spec = loadgen.WorkloadSpec(
+        seed=2, n_requests=12, rate_rps=200.0, arrival="poisson"
+    )
+    res = loadgen.replay(fake, loadgen.build(spec), clock=clock, sleep=sleep)
+    assert res["rejected"] > 0  # queue_full landed as data, not a crash
+    assert res["submitted"] + res["rejected"] == 12
+
+
+# -- the latency decomposition invariant -------------------------------------
+
+
+def test_decomposition_phases_sum_exactly():
+    """queue_wait + prefill + decode == finish - submit, per request,
+    on a fake clock (the stamps are exactly adjacent by construction —
+    any drift means a phase got double-charged)."""
+    t = [0.0]
+    m = _metrics(t)
+    walk = {
+        "a": (0.0, 0.5, 0.9, 4.0),  # submit, pop, first, finish
+        "b": (0.1, 2.0, 2.25, 6.5),
+    }
+    for rid, (s, p, f1, fin) in walk.items():
+        t[0] = s
+        m.on_submit(rid)
+        t[0] = p
+        m.on_pop(rid)
+        t[0] = f1
+        m.on_admit(rid, 4)
+        m.on_token(rid)
+        t[0] = fin
+        m.on_tokens(rid, 3)
+        m.on_finish(rid, "done")
+    for rid, (s, p, f1, fin) in walk.items():
+        ph = m.phase_breakdown(rid)
+        assert ph["queue_wait_s"] == pytest.approx(p - s)
+        assert ph["prefill_s"] == pytest.approx(f1 - p)
+        assert ph["decode_s"] == pytest.approx(fin - f1)
+        assert ph["total_s"] == pytest.approx(fin - s)
+        assert (
+            ph["queue_wait_s"] + ph["prefill_s"] + ph["decode_s"]
+            == pytest.approx(ph["total_s"])
+        )
+    # and the registry histograms observed the same phases
+    snap = m.snapshot()
+    assert snap["queue_wait_p99_s"] > 0.0
+    assert snap["prefill_p99_s"] > 0.0
+
+
+def test_honest_tail_itl_and_tpot():
+    """A drained block lands as ONE full-gap observation + n-1 zeros:
+    p99 ITL sees the stall the user saw (the old per-token mean hid a
+    gap G as n observations of G/n). TPOT is the per-request
+    amortization-proof figure: (finish - first) / (tokens - 1)."""
+    t = [0.0]
+    m = _metrics(t)
+    m.on_submit("a")
+    t[0] = 1.0
+    m.on_pop("a")
+    m.on_admit("a", 4)
+    m.on_token("a")  # first token at t=1
+    t[0] = 9.0
+    m.on_tokens("a", 8)  # one block drained after an 8 s stall
+    m.on_finish("a", "done")
+    # count and sum stay exact (9 ITL observations? no: 1 gap + 7 zeros
+    # from this drain = 8 observations, sum 8.0 — same as the old mean
+    # bucketing), but the tail now holds the REAL 8 s gap
+    st = m.itl_hist.stats()
+    assert st["count"] == 8 and st["sum"] == pytest.approx(8.0)
+    assert m.itl_hist.percentile(0.99) > 5.0  # the stall is visible
+    # zeros land in the first bucket; interpolation keeps p50 sub-ms
+    assert m.itl_hist.percentile(0.50) < 0.001
+    # TPOT = (9 - 1) / (9 tokens - 1) = 1.0 s/token, exact in the
+    # histogram sum; the snapshot percentile is the bucketed estimate
+    st = m.tpot_hist.stats()
+    assert st["count"] == 1 and st["sum"] == pytest.approx(1.0)
+    assert 0.5 <= m.snapshot()["tpot_p50_s"] <= 1.0
+
+
+def test_first_drain_zeros_unchanged():
+    """Tokens beyond the first inside the SAME first drain still record
+    0.0 ITL (they arrived together) — only later drains carry gaps."""
+    t = [1.0]
+    m = _metrics(t)
+    m.on_submit("a")
+    m.on_pop("a")
+    m.on_tokens("a", 5)
+    st = m.itl_hist.stats()
+    assert st["count"] == 4 and st["sum"] == 0.0
+
+
+# -- label propagation -------------------------------------------------------
+
+
+def test_labels_propagate_to_snapshot_and_counters():
+    t = [0.0]
+    m = _metrics(t)
+    m.on_submit("a", tenant="acme", slo_class="interactive")
+    m.on_submit("b", tenant="batchco", slo_class="batch")
+    m.on_submit("c", tenant="acme", slo_class="interactive")
+    for rid in ("a", "b"):
+        t[0] += 1.0
+        m.on_pop(rid)
+        m.on_token(rid)
+        m.on_finish(rid, "done")
+    m.on_reject("c", "queue_full")
+    snap = m.snapshot()
+    assert snap["class_interactive_finished"] == 2.0  # a done + c rejected
+    assert snap["class_batch_finished"] == 1.0
+    assert snap["tenant_acme_finished"] == 2.0
+    assert snap["tenant_batchco_finished"] == 1.0
+    # the labeled outcome counter (what a postmortem scrapes to answer
+    # "which tenant got shed")
+    c = m.registry.get("edl_serving_outcomes_total")
+    assert c.value(outcome="done", tenant="acme",
+                   slo_class="interactive") == 1.0
+    assert c.value(outcome="rejected:queue_full", tenant="acme",
+                   slo_class="interactive") == 1.0
+    assert c.value(outcome="done", tenant="batchco",
+                   slo_class="batch") == 1.0
+
+
+def test_request_dataclass_carries_labels_through_queue():
+    q = RequestQueue(max_total_len=32)
+    q.submit(Request("r", [1, 2], 4, tenant="acme", slo_class="batch"))
+    r = q.pop()
+    assert r.tenant == "acme" and r.slo_class == "batch"
+    # unlabeled requests stay None (the single-tenant feed)
+    q.submit(Request("s", [1], 2))
+    assert q.pop().tenant is None
+
+
+# -- goodput math ------------------------------------------------------------
+
+
+def _drive(m, t, rid, submit, pop, first, finish, tokens, outcome,
+           tenant="t", slo_class="interactive"):
+    t[0] = submit
+    m.on_submit(rid, tenant=tenant, slo_class=slo_class)
+    if pop is None:
+        m.on_reject(rid, "timeout")
+        return
+    t[0] = pop
+    m.on_pop(rid)
+    t[0] = first
+    m.on_admit(rid, 2)
+    m.on_token(rid)
+    if tokens > 1:
+        t[0] = finish
+        m.on_tokens(rid, tokens - 1)
+    t[0] = finish
+    m.on_finish(rid, outcome)
+
+
+def test_goodput_hand_computed():
+    """Three served + one shed request against hand-computed SLO
+    attainment: interactive ttft<=1.0 tpot<=0.25."""
+    t = [0.0]
+    m = _metrics(t)
+    classes = slo.classes_by_name(slo.default_classes(1.0, 0.25))
+    # ttft 0.5 OK, tpot (4.5-0.5)/(21-1)=0.2 OK            -> good
+    _drive(m, t, "good", 0.0, 0.2, 0.5, 4.5, 21, "done")
+    # ttft 2.0 BAD (queue wait), tpot 0.1 OK               -> not good
+    _drive(m, t, "late", 10.0, 11.8, 12.0, 13.0, 11, "done")
+    # ttft 0.3 OK, tpot (28-20.3)/(12-1)=0.7 BAD           -> not good
+    _drive(m, t, "slow", 20.0, 20.1, 20.3, 28.0, 12, "eos")
+    # shed at pop                                          -> against
+    _drive(m, t, "shed", 30.0, None, 0, 0, 0, "")
+    report = slo.compute_goodput(
+        slo.request_records(m), classes, wall_s=40.0
+    )
+    assert report["requests"] == 4
+    assert report["served"] == 3 and report["good"] == 1
+    assert report["shed"] == 1
+    assert report["ttft_slo_attainment"] == pytest.approx(2 / 3)
+    assert report["itl_slo_attainment"] == pytest.approx(2 / 3)
+    assert report["goodput_rps"] == pytest.approx(1 / 40.0)
+    assert report["throughput_rps"] == pytest.approx(3 / 40.0)
+    assert report["goodput_fraction"] == pytest.approx(1 / 4)
+    cc = report["classes"]["interactive"]
+    assert cc["good"] == 1 and cc["shed"] == 1
+    assert cc["ttft_slo_attainment"] == pytest.approx(2 / 3)
+    tc = report["tenants"]["t"]
+    assert tc["requests"] == 4 and tc["good"] == 1 and tc["shed"] == 1
+    # the phase percentiles come from the served records exactly
+    qw = report["phases"]["queue_wait_s"]
+    assert qw["p50"] == pytest.approx(sorted([0.2, 1.8, 0.1])[1])
+
+
+def test_goodput_timeout_and_unclassified():
+    t = [0.0]
+    m = _metrics(t)
+    classes = slo.classes_by_name(slo.default_classes(1.0, 0.25))
+    _drive(m, t, "to", 0.0, 0.1, 0.2, 3.0, 4, "timeout")
+    _drive(m, t, "nolabel", 5.0, 5.1, 5.2, 6.0, 4, "done",
+           tenant="", slo_class="")
+    report = slo.compute_goodput(slo.request_records(m), classes, 10.0)
+    assert report["timeout"] == 1
+    # SLO-less feed: goodput degenerates to completion
+    assert report["classes"]["unclassified"]["good"] == 1
+    assert report["tenants"]["unattributed"]["requests"] == 1
+    assert report["good"] == 1
+
+
+def test_single_token_requests_pass_the_itl_leg():
+    t = [0.0]
+    m = _metrics(t)
+    classes = slo.classes_by_name(slo.default_classes(1.0, 0.001))
+    _drive(m, t, "one", 0.0, 0.1, 0.2, 0.2, 1, "done")
+    report = slo.compute_goodput(slo.request_records(m), classes, 1.0)
+    assert report["good"] == 1  # no TPOT exists for a 1-token answer
+
+
+def test_percentiles_exact_order_stats():
+    assert slo.percentiles([]) == {"p50": 0.0, "p95": 0.0, "p99": 0.0}
+    p = slo.percentiles(list(range(1, 101)), (0.5, 0.99))
+    assert p["p50"] == pytest.approx(50.5)
+    assert p["p99"] == pytest.approx(99.01)
+
+
+def test_slo_gauges_update():
+    t = [0.0]
+    m = _metrics(t)
+    classes = slo.classes_by_name(slo.default_classes(1.0, 0.25))
+    _drive(m, t, "g", 0.0, 0.1, 0.2, 1.0, 5, "done")
+    report = slo.compute_goodput(slo.request_records(m), classes, 2.0)
+    reg = MetricsRegistry()
+    slo.update_gauges(report, registry=reg)
+    g = reg.get("edl_slo_ttft_ok_ratio")
+    assert g.value(slo_class="interactive") == 1.0
+    assert reg.get("edl_slo_goodput_rps").value() == pytest.approx(0.5)
+    # render + json both digest the same report
+    text = slo.render_report(report)
+    assert "GOODPUT" in text and "CLASS interactive" in text
+    json.dumps(report)  # JSON-able for `edl loadgen --json`
+
+
+def test_report_survives_inf_slos():
+    """Unknown classes get infinite deadlines — the report must stay
+    JSON-renderable (inf never leaks into the output fields)."""
+    t = [0.0]
+    m = _metrics(t)
+    _drive(m, t, "u", 0.0, 0.1, 0.2, 1.0, 5, "done",
+           slo_class="mystery")
+    report = slo.compute_goodput(slo.request_records(m), {}, 2.0)
+    cc = report["classes"]["mystery"]
+    assert cc["good"] == 1
+    assert math.isinf(cc["ttft_slo_s"])  # explicit, not hidden
+
+
+def test_admission_error_still_importable_from_loadgen():
+    """replay() catches AdmissionError by identity — the import path
+    must stay the scheduler's class."""
+    assert loadgen.AdmissionError is AdmissionError
