@@ -1,0 +1,10 @@
+"""The five project rules. Importing this package registers them all
+(each module calls ``core.register`` at import)."""
+
+from edl_tpu.analysis.rules import (  # noqa: F401
+    donation,
+    lockset,
+    recompile,
+    silentfail,
+    telemetry,
+)

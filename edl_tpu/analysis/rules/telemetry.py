@@ -1,0 +1,239 @@
+"""telemetry-conventions — the naming scheme every dashboard scrapes.
+
+The obs stack only works fleet-wide because names are conventions:
+Prometheus series share the ``edl_`` prefix (one scrape config, no
+collisions with cohabiting exporters), a metric name means ONE thing
+(same kind, same label schema, same buckets — ``merge_snapshot``
+adds bucket counts across workers, which is only exact when every
+registrant agrees), flight-recorder kinds are ``site.verb`` (the
+postmortem's chain matcher groups on the ``site.`` half), and every
+``fault_point`` site is exercised by a chaos plan or test (an
+uncovered site is recovery code no CI run has ever pushed through).
+
+Checks (registration sites are any ``.counter("…")`` / ``.gauge`` /
+``.histogram`` call with a literal name; dynamic names are skipped,
+never guessed):
+
+* metric names match ``edl_[a-z0-9_]+``;
+* no same-name registration with a different kind, label schema, or
+  bucket ladder anywhere in the project (cross-file, reported at the
+  later site);
+* literal event kinds in ``emit("…")`` match ``site.verb``
+  (``[a-z0-9_]+\\.[a-z0-9_]+``);
+* every literal ``fault_point("site")`` site appears somewhere in
+  tests/ or scripts/ (a chaos plan, harness, or test).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from edl_tpu.analysis.core import Finding, ModuleCtx, Project, Rule, register
+from edl_tpu.analysis.rules._util import dotted
+
+_METRIC_RE = re.compile(r"^edl_[a-z0-9_]+$")
+_KIND_RE = re.compile(r"^[a-z0-9_]+\.[a-z0-9_]+$")
+_REG_KINDS = {"counter", "gauge", "histogram"}
+_EMIT_RECEIVERS = {"events", "flight", "recorder", "rec", "self"}
+
+
+def _const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _label_schema(call: ast.Call) -> Optional[Tuple[str, ...]]:
+    """Third positional arg / ``labelnames`` kw, when literal."""
+    cand = None
+    if len(call.args) >= 3:
+        cand = call.args[2]
+    for k in call.keywords:
+        if k.arg in ("labelnames", "labels"):
+            cand = k.value
+    if cand is None:
+        return ()
+    if isinstance(cand, (ast.Tuple, ast.List)):
+        out = []
+        for e in cand.elts:
+            s = _const_str(e)
+            if s is None:
+                return None  # dynamic: skip schema comparison
+            out.append(s)
+        return tuple(out)
+    return None
+
+
+def _buckets(call: ast.Call) -> Optional[Tuple[float, ...]]:
+    for k in call.keywords:
+        if k.arg == "buckets":
+            if isinstance(k.value, (ast.Tuple, ast.List)):
+                out = []
+                for e in k.value.elts:
+                    if isinstance(e, ast.Constant) and isinstance(
+                        e.value, (int, float)
+                    ):
+                        out.append(float(e.value))
+                    else:
+                        return None
+                return tuple(out)
+            return None
+    return ()  # registry default ladder
+
+
+class _Registration:
+    __slots__ = ("name", "kind", "labels", "buckets", "path", "line")
+
+    def __init__(self, name, kind, labels, buckets, path, line):
+        self.name = name
+        self.kind = kind
+        self.labels = labels
+        self.buckets = buckets
+        self.path = path
+        self.line = line
+
+
+class TelemetryConventionsRule(Rule):
+    id = "telemetry-conventions"
+    description = (
+        "metric naming/registration consistency, event-kind format, "
+        "and fault-site test coverage"
+    )
+
+    def __init__(self):
+        self._regs: List[_Registration] = []
+        self._fault_sites: List[Tuple[str, str, int]] = []  # (site, path, line)
+
+    def check_module(self, ctx: ModuleCtx) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            d = dotted(func) or ""
+            leaf = d.rsplit(".", 1)[-1]
+
+            if (
+                isinstance(func, ast.Attribute)
+                and leaf in _REG_KINDS
+                and node.args
+            ):
+                name = _const_str(node.args[0])
+                if name is None:
+                    continue
+                if not _METRIC_RE.match(name):
+                    findings.append(
+                        Finding(
+                            rule=self.id,
+                            path=ctx.relpath,
+                            line=node.lineno,
+                            col=node.col_offset,
+                            message=(
+                                f"metric '{name}' does not follow the "
+                                "'edl_<snake_case>' naming convention"
+                            ),
+                        )
+                    )
+                self._regs.append(
+                    _Registration(
+                        name, leaf, _label_schema(node), _buckets(node),
+                        ctx.relpath, node.lineno,
+                    )
+                )
+
+            elif leaf == "emit" and node.args:
+                recv = d.rsplit(".", 1)[0] if "." in d else ""
+                if isinstance(func, ast.Name) or recv in _EMIT_RECEIVERS:
+                    kind = _const_str(node.args[0])
+                    if kind is not None and not _KIND_RE.match(kind):
+                        findings.append(
+                            Finding(
+                                rule=self.id,
+                                path=ctx.relpath,
+                                line=node.lineno,
+                                col=node.col_offset,
+                                message=(
+                                    f"event kind '{kind}' does not follow "
+                                    "the 'site.verb' convention the "
+                                    "postmortem chain matcher groups on"
+                                ),
+                            )
+                        )
+
+            elif leaf == "fault_point" and node.args:
+                site = _const_str(node.args[0])
+                if site is not None:
+                    self._fault_sites.append((site, ctx.relpath, node.lineno))
+
+        return findings
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        findings: List[Finding] = []
+
+        first: Dict[str, _Registration] = {}
+        for r in sorted(self._regs, key=lambda r: (r.path, r.line)):
+            prev = first.setdefault(r.name, r)
+            if prev is r:
+                continue
+            clash = None
+            if prev.kind != r.kind:
+                clash = f"kind {prev.kind} vs {r.kind}"
+            elif (
+                prev.labels is not None
+                and r.labels is not None
+                and prev.labels != r.labels
+            ):
+                clash = f"labels {prev.labels} vs {r.labels}"
+            elif (
+                prev.buckets is not None
+                and r.buckets is not None
+                and prev.buckets != r.buckets
+            ):
+                clash = "different bucket ladders"
+            if clash:
+                findings.append(
+                    Finding(
+                        rule=self.id,
+                        path=r.path,
+                        line=r.line,
+                        col=0,
+                        message=(
+                            f"metric '{r.name}' re-registered with a "
+                            f"conflicting schema ({clash}; first at "
+                            f"{prev.path}) — fleet merge_snapshot would "
+                            "mix incompatible series"
+                        ),
+                        severity="error",
+                    )
+                )
+
+        ref = project.reference_text()
+        seen = set()
+        for site, path, line in self._fault_sites:
+            if site in seen:
+                continue
+            seen.add(site)
+            if site not in ref:
+                findings.append(
+                    Finding(
+                        rule=self.id,
+                        path=path,
+                        line=line,
+                        col=0,
+                        message=(
+                            f"fault site '{site}' is not referenced by any "
+                            "chaos plan or test under tests//scripts/ — "
+                            "its recovery path has never been exercised"
+                        ),
+                    )
+                )
+
+        # reset per-run state (rule instances are module singletons)
+        self._regs = []
+        self._fault_sites = []
+        return findings
+
+
+register(TelemetryConventionsRule())

@@ -32,6 +32,7 @@ try:
     import yaml  # type: ignore
 
     _HAVE_YAML = True
+# edl: no-lint[silent-failure] optional-dependency probe; JSON manifests work without yaml
 except Exception:  # pragma: no cover
     _HAVE_YAML = False
 

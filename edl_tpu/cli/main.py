@@ -424,6 +424,48 @@ def run_postmortem(args) -> int:
     return 0
 
 
+def run_check(args) -> int:
+    """Project-invariant static analysis (edl_tpu/analysis/): the five
+    rules — donation-safety, lockset-race, recompile-hazard,
+    silent-failure, telemetry-conventions — over the given paths
+    (default: the edl_tpu package next to this file). Device-free:
+    pure stdlib-ast work, so it runs in CI before anything compiles.
+    Exit 0 iff no non-baselined findings; --write-baseline freezes the
+    current findings as the new baseline after a triage."""
+    import os
+
+    from edl_tpu import analysis
+
+    paths = args.paths or [
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ]
+    root = args.root or os.path.dirname(os.path.abspath(paths[0]))
+    try:
+        report = analysis.run_check(
+            paths,
+            rules=args.rule or None,
+            baseline=args.baseline,
+            root=root,
+        )
+    except (ValueError, OSError) as e:
+        print(f"edl check: {e}", file=sys.stderr)
+        return 2
+    if args.write_baseline:
+        analysis.write_baseline(
+            args.write_baseline, report.findings + report.baselined
+        )
+        print(
+            f"baseline written: {args.write_baseline} "
+            f"({len(report.findings) + len(report.baselined)} findings)"
+        )
+        return 0
+    if args.json:
+        print(analysis.render_json(report))
+    else:
+        print(analysis.render_text(report, verbose=args.verbose))
+    return 1 if report.failed else 0
+
+
 def run_export_status(args) -> int:
     """Inspect (and optionally fetch) the latest servable export — the
     consumer side of the save_inference_model contract (reference:
@@ -1310,6 +1352,41 @@ def build_parser() -> argparse.ArgumentParser:
         "degradation (the fault-free CI lane)",
     )
     pmn.set_defaults(fn=run_postmortem)
+
+    ck = sub.add_parser(
+        "check",
+        help="project-invariant static analysis (donation safety, "
+        "lockset races, recompile hazards, silent failures, telemetry "
+        "conventions)",
+    )
+    ck.add_argument(
+        "paths", nargs="*",
+        help="files/dirs to analyze (default: the edl_tpu package)",
+    )
+    ck.add_argument(
+        "--rule", action="append", default=[],
+        help="run only this rule id (repeatable; default: all five)",
+    )
+    ck.add_argument(
+        "--baseline", default=None,
+        help="baseline JSON: findings covered there do not fail the run",
+    )
+    ck.add_argument(
+        "--write-baseline", default=None, metavar="PATH",
+        help="triage workflow: write the current findings (incl. "
+        "currently-baselined ones) as the new baseline and exit 0",
+    )
+    ck.add_argument("--json", action="store_true", help="machine-readable report")
+    ck.add_argument(
+        "--verbose", action="store_true",
+        help="also list baselined findings",
+    )
+    ck.add_argument(
+        "--root", default=None,
+        help="repo root anchoring relative paths and the tests//scripts/ "
+        "reference corpus (default: parent of the first path)",
+    )
+    ck.set_defaults(fn=run_check)
 
     ex = sub.add_parser(
         "export-status",

@@ -424,7 +424,7 @@ class FakeCluster(Cluster):
                         mem_mega=c.plan.mem_mega,
                         chips=0,
                     )
-            self._place()
+            self._place_locked()
             # refresh group/coordinator status counts
             for (ns, gname), g in self.groups.items():
                 g.active = sum(
@@ -438,7 +438,7 @@ class FakeCluster(Cluster):
                 p = self.pods.get(f"{ns}/{cname}-0")
                 c.ready_replicas = 1 if p and p.phase == PodPhase.RUNNING else 0
 
-    def _place(self) -> None:
+    def _place_locked(self) -> None:
         free_cpu = {h.name: h.cpu_milli for h in self.hosts.values()}
         free_mem = {h.name: h.mem_mega for h in self.hosts.values()}
         free_chip = {h.name: h.chips for h in self.hosts.values()}

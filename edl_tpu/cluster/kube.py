@@ -741,8 +741,13 @@ class KubeJobSource:
 
     def _watch_loop(self) -> None:
         path = self.cluster.training_job_list_path(self.namespace)
+        # _stop is a monotonic bool close() flips to interrupt this loop
+        # (worst case: one extra watch cycle); _conn/_rv are owned by
+        # this thread, close() only pokes _conn to break a blocked read
+        # edl: no-lint[lockset-race]
         while not self._stop:
             try:
+                # edl: no-lint[lockset-race] _conn cleared by its owning thread; see loop-head note
                 del self._conn[:]
                 for ev in self.cluster.api.watch(
                     path, resource_version=self._rv,
@@ -798,6 +803,7 @@ class KubeJobSource:
         for resp in self._conn:
             try:  # interrupt a read blocked on an idle stream
                 resp.close()
+            # edl: no-lint[silent-failure] interrupting a blocked watch read; a already-dead stream is the success case
             except Exception:
                 pass
 

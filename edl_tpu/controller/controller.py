@@ -44,6 +44,11 @@ class Controller:
             rescale_cooldown_s=rescale_cooldown_s,
         )
         self.updaters: Dict[str, JobUpdater] = {}
+        # watch events land on the cluster's watch thread while the
+        # updater ticker iterates on its own thread: every access to
+        # the updaters map goes through this lock (found by `edl check`
+        # lockset-race; pinned by test_controller concurrency test)
+        self._lock = threading.Lock()
         self._stop = threading.Event()
         self._threads: list = []
         if hasattr(cluster, "watch_jobs"):
@@ -65,16 +70,18 @@ class Controller:
         """reference: onAdd parses + creates child resources and notifies
         the autoscaler (pkg/controller.go:110-148); here resource creation
         is delegated to the updater's state machine."""
-        if job.qualified_name in self.updaters:
-            return
-        log.info("job added", job=job.qualified_name)
         updater = JobUpdater(job, self.cluster, self.parser)
-        self.updaters[job.qualified_name] = updater
-        updater.step()  # parse + begin creating
+        with self._lock:
+            if job.qualified_name in self.updaters:
+                return
+            self.updaters[job.qualified_name] = updater
+        log.info("job added", job=job.qualified_name)
+        updater.step()  # parse + begin creating (outside the map lock)
         self.autoscaler.on_add(job)
 
     def on_update(self, job: TrainingJob) -> None:
-        u = self.updaters.get(job.qualified_name)
+        with self._lock:
+            u = self.updaters.get(job.qualified_name)
         if u is None:
             self.on_add(job)
             return
@@ -82,14 +89,16 @@ class Controller:
         self.autoscaler.on_update(job)
 
     def on_delete(self, job: TrainingJob) -> None:
-        u = self.updaters.pop(job.qualified_name, None)
+        with self._lock:
+            u = self.updaters.pop(job.qualified_name, None)
         if u is not None:
             u.delete()
         self.autoscaler.on_del(job)
         log.info("job deleted", job=job.qualified_name)
 
     def _on_scale(self, job_name: str, new_parallelism: int) -> None:
-        u = self.updaters.get(job_name)
+        with self._lock:
+            u = self.updaters.get(job_name)
         if u is not None:
             u.on_scale(new_parallelism)
 
@@ -100,7 +109,9 @@ class Controller:
         reference: trainingJobUpdater.go:471-478). Errors are isolated
         per updater: one job that fails every tick (bad manifest,
         cluster 4xx) must not starve reconciliation of the others."""
-        for u in list(self.updaters.values()):
+        with self._lock:
+            updaters = list(self.updaters.values())
+        for u in updaters:
             try:
                 u.step()
             except Exception as e:
@@ -138,5 +149,6 @@ class Controller:
     def phase_of(self, job_name: str) -> JobPhase:
         """job_name is the qualified name (bare name in the default
         namespace)."""
-        u = self.updaters.get(job_name)
+        with self._lock:
+            u = self.updaters.get(job_name)
         return u.phase if u else JobPhase.NONE

@@ -219,9 +219,14 @@ class FlightRecorder:
     # -- serialization ------------------------------------------------------
 
     def _meta_record(self, retained: int) -> Dict[str, Any]:
+        # callers (to_jsonl/window_json) snapshot events BEFORE this,
+        # so the lock is free to take here; the unguarded `dropped`
+        # read was an `edl check` lockset-race finding
+        with self._lock:
+            dropped = self.dropped
         return {
             "meta": {
-                "dropped": self.dropped,
+                "dropped": dropped,
                 "max_events": self.max_events,
                 "retained": retained,
                 "pid": os.getpid(),
@@ -303,7 +308,8 @@ class FlightRecorder:
             tracer = tracing.tracer()
         doc = tracer.to_chrome_doc()
         doc["traceEvents"].extend(self.to_chrome_events(tracer))
-        doc["eventsDropped"] = self.dropped
+        with self._lock:
+            doc["eventsDropped"] = self.dropped
         return doc
 
 
@@ -376,8 +382,11 @@ def crash_dump(tag: str, err: Optional[BaseException] = None) -> Optional[str]:
     try:
         rec = default_recorder()
         if err is not None:
+            # kind follows site.verb so the postmortem's chain matcher
+            # can group it (was bare "crash"; edl check
+            # telemetry-conventions)
             rec.emit(
-                "crash", severity="error",
+                "blackbox.crash", severity="error",
                 error=f"{type(err).__name__}: {err}", tag=tag,
             )
         with _default_lock:
@@ -385,6 +394,7 @@ def crash_dump(tag: str, err: Optional[BaseException] = None) -> Optional[str]:
             n = _dump_seq
         path = os.path.join(d, f"blackbox-{tag}-{os.getpid()}-{n}.jsonl")
         return rec.dump(path)
+    # edl: no-lint[silent-failure] the black box is best-effort BY CONTRACT: it runs inside recovery paths and must never take them down
     except Exception:  # pragma: no cover - the black box is best-effort
         return None
 
@@ -458,6 +468,7 @@ def _log_event(level: str, logger: str, msg: str, kv: Dict[str, Any]) -> None:
             **corr,
             **attrs,
         )
+    # edl: no-lint[silent-failure] the log->event sink itself: logging a sink failure would recurse into the sink
     except Exception:  # pragma: no cover - telemetry must never raise
         pass
 

@@ -82,6 +82,11 @@ class MetricsPusher:
         self._recorder = recorder
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # push_once runs on the pusher thread AND from stop()'s
+        # last-gasp call on the owner's thread; the streak/backoff
+        # state it shares with next_wait_s is lock-guarded (found by
+        # `edl check` lockset-race; pinned by test_obs concurrency test)
+        self._state_lock = threading.Lock()
         self._failing = False
         self._fail_streak = 0
         # private PRNG: jitter must not perturb anyone's seeded
@@ -104,29 +109,34 @@ class MetricsPusher:
                     rec = _events.default_recorder()
                 # single-line doc: coordinator KV is a line protocol
                 self._events_publish(rec.window_json(self.events_window))
-            self.pushes += 1
-            self._failing = False
-            self._fail_streak = 0
+            with self._state_lock:
+                self.pushes += 1
+                self._failing = False
+                self._fail_streak = 0
             return True
         except Exception as e:
-            self._fail_streak += 1
+            with self._state_lock:
+                self._fail_streak += 1
+                first_of_streak = not self._failing
+                self._failing = True
             default_registry().counter(
                 "edl_metrics_push_failures_total",
                 "metrics snapshot pushes that raised",
             ).inc()
-            if not self._failing:
+            if first_of_streak:
                 log.warn("metrics push failed (will retry)", error=str(e))
-                self._failing = True
             return False
 
     def next_wait_s(self) -> float:
         """Delay before the next push attempt: the fixed interval while
         healthy; doubling from the interval per consecutive failure,
         capped and jittered ±50%, while failing."""
-        if self._fail_streak == 0:
+        with self._state_lock:
+            streak = self._fail_streak
+        if streak == 0:
             return self.interval_s
         base = min(
-            self.interval_s * (2 ** min(self._fail_streak, 16)),
+            self.interval_s * (2 ** min(streak, 16)),
             self.backoff_cap_s,
         )
         return base * (0.5 + self._rng.random())

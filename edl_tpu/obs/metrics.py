@@ -113,7 +113,7 @@ class _Family:
                 f"got {tuple(sorted(labels))}"
             ) from None
 
-    def _sample(self, labels: Dict[str, str]):
+    def _sample_locked(self, labels: Dict[str, str]):
         key = self._key(labels)
         s = self._samples.get(key)
         if s is None:
@@ -137,7 +137,7 @@ class Counter(_Family):
         if n < 0:
             raise ValueError(f"{self.name}: counters only go up (got {n})")
         with self._lock:
-            self._sample(labels)[0] += n
+            self._sample_locked(labels)[0] += n
 
     def value(self, **labels: str) -> float:
         with self._lock:
@@ -161,11 +161,11 @@ class Gauge(_Family):
 
     def set(self, v: float, **labels: str) -> None:
         with self._lock:
-            self._sample(labels)[0] = float(v)
+            self._sample_locked(labels)[0] = float(v)
 
     def inc(self, n: float = 1.0, **labels: str) -> None:
         with self._lock:
-            self._sample(labels)[0] += n
+            self._sample_locked(labels)[0] += n
 
     def value(self, **labels: str) -> float:
         with self._lock:
@@ -219,7 +219,7 @@ class Histogram(_Family):
     def observe(self, v: float, n: float = 1.0, **labels: str) -> None:
         i = bisect.bisect_left(self.buckets, v)
         with self._lock:
-            s = self._sample(labels)
+            s = self._sample_locked(labels)
             s.counts[i] += n
             s.sum += v * n
             s.count += n
@@ -409,7 +409,7 @@ class MetricsRegistry:
                     if tuple(rec.get("buckets", ())) != fam.buckets:
                         continue  # incompatible edges: not mergeable
                     with fam._lock:
-                        dst = fam._sample(lv)
+                        dst = fam._sample_locked(lv)
                         for i, c in enumerate(s.get("counts", [])):
                             if i < len(dst.counts):
                                 dst.counts[i] += c

@@ -165,6 +165,7 @@ def top_once(endpoint: str, timeout_s: float = 5.0) -> str:
     try:
         hz = json.loads(scrape(endpoint, "/healthz", timeout_s=timeout_s))
         header = f"{endpoint}  up {hz.get('uptime_s', 0):.0f}s pid {hz.get('pid', '?')}"
+    # edl: no-lint[silent-failure] /healthz is an optional endpoint; plain Prometheus targets lack it by design
     except Exception:
         pass  # /healthz is optional: any Prometheus endpoint works
     body = summarize(parse_prometheus_text(text))

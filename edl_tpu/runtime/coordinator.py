@@ -224,6 +224,7 @@ class NativeCoordinator:
     def __del__(self):  # pragma: no cover
         try:
             self.close()
+        # edl: no-lint[silent-failure] __del__ during interpreter shutdown: nothing to report to, must never raise
         except Exception:
             pass
 

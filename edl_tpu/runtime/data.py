@@ -66,9 +66,9 @@ class ElasticDataQueue:
         self._done_count = 0
         self._dead: List[Task] = []  # tasks that exceeded MAX_TASK_FAILURES
         self._next_id = 0
-        self._fill_epoch(0)
+        self._fill_epoch_locked(0)
 
-    def _fill_epoch(self, epoch: int) -> None:
+    def _fill_epoch_locked(self, epoch: int) -> None:
         for start in range(0, self.n_samples, self.chunk_size):
             self._todo.append(
                 Task(
@@ -94,9 +94,9 @@ class ElasticDataQueue:
         # the redelivery invariant exp_chaos.py soaks
         faults.fault_point("data.lease")
         with self._lock:
-            self._reap_expired()
+            self._reap_expired_locked()
             if not self._todo and not self._leases:
-                self._advance_epoch()
+                self._advance_epoch_locked()
             if not self._todo:
                 return None
             task = self._todo.pop(0)
@@ -112,14 +112,14 @@ class ElasticDataQueue:
             if lease is not None:
                 self._done_count += 1
                 if not self._todo and not self._leases:
-                    self._advance_epoch()
+                    self._advance_epoch_locked()
 
     def nack(self, task_id: int) -> None:
         """Return a task to the queue (worker failed mid-chunk)."""
         with self._lock:
             lease = self._leases.pop(task_id, None)
             if lease is not None:
-                self._requeue(lease.task)
+                self._requeue_locked(lease.task)
 
     def release_worker(self, worker: str) -> int:
         """Requeue every task leased by a departed worker (membership
@@ -128,14 +128,14 @@ class ElasticDataQueue:
         with self._lock:
             gone = [tid for tid, l in self._leases.items() if l.worker == worker]
             for tid in gone:
-                self._requeue(self._leases.pop(tid).task)
+                self._requeue_locked(self._leases.pop(tid).task)
             return len(gone)
 
     # -- state -------------------------------------------------------------
 
     def done(self) -> bool:
         with self._lock:
-            self._reap_expired()
+            self._reap_expired_locked()
             return not self._todo and not self._leases and self._epoch >= self.passes - 1
 
     def progress(self) -> Dict[str, int]:
@@ -150,23 +150,23 @@ class ElasticDataQueue:
 
     # -- internals (lock held) ---------------------------------------------
 
-    def _requeue(self, task: Task) -> None:
+    def _requeue_locked(self, task: Task) -> None:
         task.failures += 1
         if task.failures > MAX_TASK_FAILURES:
             self._dead.append(task)
         else:
             self._todo.append(task)
 
-    def _reap_expired(self) -> None:
+    def _reap_expired_locked(self) -> None:
         now = time.monotonic()
         expired = [tid for tid, l in self._leases.items() if l.expires <= now]
         for tid in expired:
-            self._requeue(self._leases.pop(tid).task)
+            self._requeue_locked(self._leases.pop(tid).task)
 
-    def _advance_epoch(self) -> bool:
+    def _advance_epoch_locked(self) -> bool:
         if self._epoch < self.passes - 1:
             self._epoch += 1
-            self._fill_epoch(self._epoch)
+            self._fill_epoch_locked(self._epoch)
             return True
         return False
 

@@ -98,6 +98,9 @@ class P2PRestorePlane:
 
             client.kv_put(self._k("p2p_token"), secrets.token_hex(16))
             tok = client.kv_get(self._k("p2p_token"))
+        # written once at bring-up before any probe/server thread can
+        # read it (start() precedes roster publication); immutable after
+        # edl: no-lint[lockset-race]
         self.token = tok
         self.server = ShardServer(
             self._get_snapshot,
@@ -284,8 +287,13 @@ class P2PRestorePlane:
             # one key per step — a blind, raceless write)
             try:
                 cl.kv_put(self._k("p2p_veto", str(step)), str(epoch))
-            except Exception:
-                pass
+            except Exception as ve:
+                # a lost veto means the regroup may re-pick this dead
+                # step — loud, not silent (edl check silent-failure)
+                log.warn(
+                    "p2p veto publish failed; regroup may retry step",
+                    step=step, error=str(ve),
+                )
             raise
         finally:
             for r in remotes:
@@ -309,8 +317,13 @@ class P2PRestorePlane:
         while True:
             try:
                 restored = int(cl.kv_get(self._k("restored_step")) or "-1")
-            except Exception:
-                return  # coordinator gone: the job is over
+            except Exception as e:
+                # coordinator gone: the job is over — but exiting the
+                # drain window mid-migration is worth one warn line on
+                # the timeline (edl check silent-failure)
+                log.warn("coordinator unreachable during p2p linger; "
+                         "departing", error=str(e))
+                return
             if restored >= snap.step:
                 return
             if time.monotonic() > deadline and srv.active == 0:

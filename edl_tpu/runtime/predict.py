@@ -135,6 +135,9 @@ def _predict_ctr(params, rows) -> Dict[str, Any]:
     _need(rows, "dense", "sparse")
     dense = np.asarray(rows["dense"], np.float32)
     sparse = np.asarray(rows["sparse"], np.int32)
+    # one jit per `edl predict` invocation by design (the chunk loop
+    # below reuses it); no steady-state path re-enters this function
+    # edl: no-lint[recompile-hazard]
     fwd = jax.jit(ctr.forward)
     logits = np.concatenate([
         np.asarray(fwd(params, jnp.asarray(dense[c]), jnp.asarray(sparse[c])))
@@ -162,6 +165,7 @@ def _predict_resnet(params, meta, rows) -> Dict[str, Any]:
     _need(rows, "images")
     cfg = resnet.ResNetConfig.from_meta(meta)
     images = np.asarray(rows["images"], np.float32)
+    # edl: no-lint[recompile-hazard] one jit per CLI predict invocation; cfg comes from the export being loaded
     fwd = jax.jit(lambda p, x: resnet.forward(p, x, cfg))
     cls = np.concatenate([
         np.asarray(jnp.argmax(fwd(params, jnp.asarray(images[c])), -1))
@@ -183,6 +187,7 @@ def _predict_bert(params, meta, rows) -> Dict[str, Any]:
 
     _need(rows, "tokens")
     cfg = bert.BertConfig.from_meta(meta)
+    # edl: no-lint[recompile-hazard] one jit per CLI predict invocation; cfg comes from the export being loaded
     fwd = jax.jit(lambda p, t: bert.forward(p, t, cfg))
     # the SAME chunked masked-accuracy math the in-job eval publishes
     acc, pred = masked_top1(

@@ -249,7 +249,10 @@ class _Conn:
         self.sock = None
         self.file = None
 
-    def connect(self) -> None:
+    def _connect_locked(self) -> None:
+        """Open + AUTH the socket. ``self.lock`` must be held — the
+        ``_locked`` suffix is the lock convention `edl check`'s
+        lockset-race rule recognizes."""
         host, port = self.addr.rsplit(":", 1)
         self.sock = socket.create_connection(
             (host, int(port)), timeout=_IO_TIMEOUT_S
@@ -263,13 +266,21 @@ class _Conn:
             if _read_line(self.file) != "OK":
                 raise OSError(f"peer {self.addr} rejected auth")
 
-    def close(self) -> None:
+    def _close_locked(self) -> None:
         try:
             if self.sock is not None:
                 self.sock.close()
         except OSError:
             pass
         self.sock = self.file = None
+
+    def close(self) -> None:
+        """Public close takes the lock: a teardown racing an in-flight
+        ``fetch_batch`` on another thread must not None the file out
+        from under a read (waits for the current batch instead —
+        `edl check` lockset-race found the unguarded variant)."""
+        with self.lock:
+            self._close_locked()
 
     def fetch_batch(
         self, entries: Sequence[str], dtypes: Dict[str, str]
@@ -282,7 +293,7 @@ class _Conn:
             for attempt in (0, 1):  # one reconnect per batch
                 try:
                     if self.sock is None:
-                        self.connect()
+                        self._connect_locked()
                     req = (f"FETCHN {len(entries)}\n" + "".join(
                         e + "\n" for e in entries
                     )).encode()
@@ -329,7 +340,7 @@ class _Conn:
                         )
                     return out
                 except (OSError, ValueError):
-                    self.close()
+                    self._close_locked()  # self.lock already held here
                     out.clear()
                     if attempt:
                         raise

@@ -546,6 +546,9 @@ class ContinuousBatchingEngine:
                 old[4], old[5], self._next_key(), self._temp(),
             )
         self.metrics.on_dispatch("decode")
+        # deliberate read of the donated refs: is_deleted() PROBES that
+        # donation actually happened (the runtime half of this invariant)
+        # edl: no-lint[donation-safety]
         self._assert_donated(*old)
         flight.emit("serve.block", active=self.active_slots,
                     horizon=self.horizon)
@@ -736,6 +739,7 @@ class ContinuousBatchingEngine:
                 self._temp(),
             )
             self.metrics.on_dispatch("prefill")
+            # edl: no-lint[donation-safety] deliberate is_deleted() probe of the donation contract
             self._assert_donated(*old)
             flight.emit("serve.prefill", rid=rid, slot=slot, bucket=tb,
                         replay=replay)

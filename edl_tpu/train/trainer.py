@@ -82,6 +82,7 @@ def state_pspecs(state: TrainState, plan: MeshPlan, param_pspecs=None):
         try:
             if jax.tree_util.tree_structure(node) != param_treedef:
                 return False
+        # edl: no-lint[silent-failure] structure probe: "not param-shaped" is the answer, not an error
         except Exception:
             return False
         shapes = [

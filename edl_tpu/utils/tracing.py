@@ -126,14 +126,14 @@ class Tracer:
         ``_tracer`` meta entry carrying the ring-buffer accounting
         (retained span count + evictions) so a truncated window is
         visible to every summary consumer."""
-        spans = self.spans()
+        spans, dropped = self._snapshot()
         out: Dict[str, Dict[str, float]] = {}
         for s in spans:
             agg = out.setdefault(s.name, {"count": 0, "total_s": 0.0, "max_s": 0.0})
             agg["count"] += 1
             agg["total_s"] += s.dur_s
             agg["max_s"] = max(agg["max_s"], s.dur_s)
-        out["_tracer"] = {"spans": len(spans), "dropped": self.dropped}
+        out["_tracer"] = {"spans": len(spans), "dropped": dropped}
         return out
 
     def to_chrome_trace(self) -> List[Dict[str, Any]]:
@@ -152,12 +152,31 @@ class Tracer:
             for s in self.spans()
         ]
 
+    def _snapshot(self):
+        """(spans, dropped) under one lock acquire: readers must see a
+        consistent pair (the unguarded ``self.dropped`` reads were an
+        `edl check` lockset-race finding)."""
+        with self._lock:
+            return list(self._spans), self.dropped
+
     def to_chrome_doc(self) -> Dict[str, Any]:
         """Full chrome-trace JSON document: the events plus a metadata
         ("M") event and top-level ``dropped``, so a viewer AND a raw
         reader both see ring-buffer truncation. Served by the obs
         exporter's ``/trace`` and written by :meth:`dump`."""
-        events = self.to_chrome_trace()
+        spans, dropped = self._snapshot()
+        events = [
+            {
+                "name": s.name,
+                "ph": "X",
+                "ts": s.start_s * 1e6,
+                "dur": s.dur_s * 1e6,
+                "pid": os.getpid(),
+                "tid": s.thread % 2**31,
+                "args": s.attrs,
+            }
+            for s in spans
+        ]
         events.append(
             {
                 "name": "edl_tracer",
@@ -165,25 +184,26 @@ class Tracer:
                 "pid": os.getpid(),
                 "tid": 0,
                 "args": {
-                    "dropped": self.dropped,
+                    "dropped": dropped,
                     "max_spans": self.max_spans,
                     "spans": len(events),
                 },
             }
         )
-        return {"traceEvents": events, "dropped": self.dropped}
+        return {"traceEvents": events, "dropped": dropped}
 
     def dump(self, path: str) -> None:
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
+        doc = self.to_chrome_doc()
         with open(path, "w") as f:
-            json.dump(self.to_chrome_doc(), f)
+            json.dump(doc, f)
         log.info(
             "trace written",
             path=path,
-            spans=len(self.spans()),
-            dropped=self.dropped,
+            spans=max(len(doc["traceEvents"]) - 1, 0),
+            dropped=doc["dropped"],
         )
 
 

@@ -20,4 +20,12 @@ if grep -rlP '\t' edl_tpu/ tests/ --include='*.py'; then
     echo "tabs found in python source" >&2; exit 1
 fi
 
+echo "== edl check: project-invariant static analysis =="
+# the go-vet analog, specialized to THIS repo's contracts: donation
+# safety, lockset races, recompile hazards, silent failures, telemetry
+# conventions (edl_tpu/analysis/). Fails on any NON-BASELINED finding;
+# deliberate violations carry `# edl: no-lint[rule]` comments at the
+# site or a reasoned entry in analysis_baseline.json.
+python -m edl_tpu.cli check --baseline analysis_baseline.json
+
 echo "style OK"

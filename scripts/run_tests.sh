@@ -21,20 +21,35 @@ set -u
 cd "$(dirname "$0")/.."
 
 t0=$(date +%s)
+echo "== phase 0: edl check (project-invariant static analysis) =="
+# runs FIRST: a donation-safety / lockset / telemetry violation fails
+# the suite before anything compiles. Baseline covers the triaged
+# deliberate findings; anything NEW fails here.
+python -m edl_tpu.cli check --baseline analysis_baseline.json
+rc0=$?
+tA=$(date +%s)
+echo "== phase 0 done in $((tA - t0))s (rc=$rc0) =="
+
+# faulthandler with a dump-all-threads timeout: if a lockset fix ever
+# introduces a deadlock, CI logs show every thread's stack instead of
+# an opaque job timeout. 300 s is far above any single test's healthy
+# runtime; the dump does not fail the test, it makes the hang visible.
+FH="-p faulthandler -o faulthandler_timeout=300"
+
 echo "== phase 1: fast set (not multiproc, not slow) =="
-python -m pytest tests/ -m "not multiproc and not slow" -q "$@"
+python -m pytest tests/ -m "not multiproc and not slow" -q $FH "$@"
 rc1=$?
 t1=$(date +%s)
 echo "== phase 1 done in $((t1 - t0))s (rc=$rc1) =="
 
 echo "== phase 2: multiproc set (serial, isolated) =="
-python -m pytest tests/ -m multiproc -q "$@"
+python -m pytest tests/ -m multiproc -q $FH "$@"
 rc2=$?
 t2=$(date +%s)
 echo "== phase 2 done in $((t2 - t1))s (rc=$rc2) =="
 
 echo "== phase 3: slow soak lane =="
-python -m pytest tests/ -m slow -q "$@"
+python -m pytest tests/ -m slow -q $FH "$@"
 rc3=$?
 t3=$(date +%s)
 echo "== phase 3 done in $((t3 - t2))s (rc=$rc3) =="
@@ -118,4 +133,4 @@ t7=$(date +%s)
 echo "== phase 7 done in $((t7 - t6))s (rc=$rc7) =="
 echo "== total $((t7 - t0))s =="
 
-[ "$rc1" -eq 0 ] && [ "$rc2" -eq 0 ] && [ "$rc3" -eq 0 ] && [ "$rc4" -eq 0 ] && [ "$rc5" -eq 0 ] && [ "$rc6" -eq 0 ] && [ "$rc7" -eq 0 ]
+[ "$rc0" -eq 0 ] && [ "$rc1" -eq 0 ] && [ "$rc2" -eq 0 ] && [ "$rc3" -eq 0 ] && [ "$rc4" -eq 0 ] && [ "$rc5" -eq 0 ] && [ "$rc6" -eq 0 ] && [ "$rc7" -eq 0 ]

@@ -5,6 +5,8 @@ clientset was "never used" there); state-machine semantics follow
 pkg/updater/trainingJobUpdater.go.
 """
 
+import threading
+
 from edl_tpu.api.job import JobPhase, ResourceState, TrainingJob
 from edl_tpu.cluster.fake import FakeCluster, FakeHost
 from edl_tpu.controller.controller import Controller
@@ -183,3 +185,42 @@ def test_controller_threaded_run():
         time.sleep(0.05)
     ctl.stop()
     assert c.get_worker_group(job).parallelism == 4
+
+
+def test_updater_map_threadsafe_under_churn():
+    """Watch events (on_add/on_delete) land on the cluster's watch
+    thread while the updater ticker iterates on its own — the updaters
+    map is lock-guarded (`edl check` lockset-race finding). Churn jobs
+    from the event side while step() spins: no lost or resurrected
+    updaters, no dict-mutation errors escaping the tick."""
+    c = tpu_fleet(n=16)
+    ctl = Controller(c, max_load_desired=1.0)
+    tick_errors = []
+    stop = threading.Event()
+
+    def ticker():
+        while not stop.is_set():
+            try:
+                ctl.step()
+            except RuntimeError as e:  # "dict changed size" class
+                tick_errors.append(e)
+
+    t = threading.Thread(target=ticker, daemon=True)
+    t.start()
+    jobs = [make_job(name=f"churn{i}", lo=1, hi=2, chips=4) for i in range(24)]
+    try:
+        for i, job in enumerate(jobs):
+            ctl.on_add(job)
+            if i % 2:
+                ctl.on_delete(job)
+    finally:
+        stop.set()
+        t.join(5)
+    assert not tick_errors
+    kept = {f"churn{i}" for i in range(24) if i % 2 == 0}
+    assert {u.rsplit("/", 1)[-1] for u in ctl.updaters} == kept
+    # duplicate add on the event thread must stay a no-op (the
+    # check-then-insert is one atomic section now)
+    before = dict(ctl.updaters)
+    ctl.on_add(jobs[0])
+    assert ctl.updaters == before

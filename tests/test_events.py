@@ -160,7 +160,7 @@ def test_crash_dump_writes_blackbox(tmp_path, monkeypatch):
     loaded = flight.load_jsonl(path)
     kinds = [e["kind"] for e in loaded]
     assert "before.crash" in kinds
-    crash = next(e for e in loaded if e["kind"] == "crash")
+    crash = next(e for e in loaded if e["kind"] == "blackbox.crash")
     assert crash["severity"] == "error"
     assert "boom" in crash["attrs"]["error"]
 

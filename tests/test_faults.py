@@ -190,3 +190,26 @@ def test_json_plan_arm_direct():
     ]})
     assert len(specs) == 1 and specs[0].p == 1.0
     assert _fires("a", 3) == [True, False, False]
+
+
+# -- real fault-site coverage ------------------------------------------------
+
+
+def test_data_lease_site_fires_on_real_path():
+    """`data.lease` is declared on ElasticDataQueue.get_task — the
+    redelivery path chaos exercises. Arm it here so every declared
+    fault site is exercised by at least one test (the `edl check`
+    telemetry-conventions coverage gate), and pin that a lost lease
+    call is survivable: the task is NOT leased when the fault fires
+    before the lease is taken, so a retry hands it out intact."""
+    from edl_tpu.runtime.data import ElasticDataQueue
+
+    q = ElasticDataQueue(n_samples=4, chunk_size=2, passes=1)
+    faults.arm("data.lease:raise@n=1")
+    with pytest.raises(faults.InjectedFault):
+        q.get_task("w0")
+    # the fault fired BEFORE the lease was taken: nothing leaked
+    assert q.progress()["leased"] == 0
+    t1 = q.get_task("w0")  # retry succeeds and leases the same work
+    assert t1 is not None and t1.start == 0
+    assert faults.counts() == {"data.lease": 1}

@@ -300,6 +300,44 @@ def test_pusher_survives_failing_publish():
     assert p.push_once() is False  # swallowed, telemetry never raises
 
 
+def test_pusher_backoff_state_lock_guarded_under_contention():
+    """push_once runs on the pusher thread AND from stop()'s last-gasp
+    call while next_wait_s polls the streak — the backoff state is
+    lock-guarded (`edl check` lockset-race finding). Hammer failing
+    pushes from many threads: every increment must land (unlocked
+    `+= 1` loses updates under bytecode interleaving), and one success
+    must reset the streak for every observer."""
+    import threading
+
+    fail = {"on": True}
+
+    def pub(_):
+        if fail["on"]:
+            raise ConnectionError("down")
+
+    reg = obs.MetricsRegistry()
+    p = obs.MetricsPusher(pub, interval_s=1.0, backoff_cap_s=64.0, registry=reg)
+    n_threads, n_pushes = 8, 50
+    start = threading.Barrier(n_threads)
+
+    def hammer():
+        start.wait()
+        for _ in range(n_pushes):
+            p.push_once()
+            assert p.next_wait_s() >= 0.5  # jitter floor of the backoff
+
+    threads = [threading.Thread(target=hammer) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert p._fail_streak == n_threads * n_pushes  # no lost increments
+    fail["on"] = False
+    assert p.push_once() is True
+    assert p._fail_streak == 0
+    assert p.next_wait_s() == 1.0  # healthy cadence restored
+
+
 # ---------------------------------------------------------------------------
 # live exporter scrape
 

@@ -10,6 +10,7 @@ from edl_tpu.runtime.checkpoint import LocalSnapshot, _piece_key
 from edl_tpu.runtime.shard_server import (
     RemotePieces,
     ShardServer,
+    _Conn,
     fetch_index,
 )
 
@@ -382,3 +383,67 @@ def test_pure_peer_restore_reassembles_state(cpu_devices):
     finally:
         for srv in servers:
             srv.close()
+
+
+def test_conn_close_waits_for_inflight_batch():
+    """_Conn.close() takes the connection lock (`edl check`
+    lockset-race finding): a teardown racing an in-flight fetch_batch
+    must not None the socket/file out from under a blocked read — it
+    waits for the batch to finish instead."""
+    import threading
+    import time as _time
+
+    conn = _Conn("127.0.0.1:1", token=None)
+    conn.lock.acquire()  # simulate fetch_batch mid-flight on another thread
+    closed = threading.Event()
+
+    def do_close():
+        conn.close()
+        closed.set()
+
+    t = threading.Thread(target=do_close, daemon=True)
+    t.start()
+    _time.sleep(0.05)
+    assert not closed.is_set()  # close is waiting behind the batch
+    conn.lock.release()
+    assert closed.wait(2.0)
+    assert conn.sock is None and conn.file is None
+
+
+def test_conn_close_during_parallel_get_many_is_clean():
+    """End-to-end teardown race: threads drain get_many stripes while
+    another thread closes the pool. The only acceptable outcomes are
+    full results or connection errors — never an AttributeError from a
+    half-torn _Conn."""
+    import threading
+
+    w = np.arange(64, dtype=np.float32).reshape(8, 8)
+    snap = _snap(3, {"p:w": [((0, 0), w)]})
+    srv = ShardServer(lambda: snap)
+    entry = _piece_key("p:w", (0, 0), (8, 8))
+    oddities = []
+
+    for _ in range(5):
+        rp = RemotePieces(
+            f"127.0.0.1:{srv.port}", {entry: "float32"}, nconn=2
+        )
+
+        def fetch():
+            try:
+                rp.get_many([entry])
+            except (OSError, ValueError, KeyError):
+                pass  # torn by close: expected outcome
+            except AttributeError as e:  # half-torn connection state
+                oddities.append(e)
+
+        ts = [threading.Thread(target=fetch) for _ in range(3)]
+        closer = threading.Thread(target=rp.close)
+        for t in ts:
+            t.start()
+        closer.start()
+        for t in ts:
+            t.join(10)
+        closer.join(10)
+        rp.close()
+    srv.close()
+    assert not oddities
