@@ -55,24 +55,15 @@ WARMUP = 2  # chunks (CHUNK steps each) before timing
 MEASURE = 240
 CHUNK = 12  # steps fused per dispatch (lax.scan) in the measure loop
 
-# bf16 peak TFLOP/s by device kind substring (MFU denominator); the
-# public per-chip numbers for each TPU generation
-_PEAK_BF16 = (
-    ("v6", 918e12),
-    ("v5p", 459e12),
-    ("v5e", 197e12),
-    ("v5 lite", 197e12),
-    ("v5", 459e12),
-    ("v4", 275e12),
-)
+# device peaks (MFU / roofline denominators) live in the shared cost
+# model (edl_tpu/obs/costmodel.py) — the ONE table bench, exp_mfu, and
+# the live efficiency gauges read. Spec values, no env overrides here:
+# published pct-of-peak must stay comparable across rounds.
+from edl_tpu.obs import costmodel as _costmodel
 
 
 def _peak_flops(device) -> float:
-    kind = getattr(device, "device_kind", "").lower()
-    for sub, peak in _PEAK_BF16:
-        if sub in kind:
-            return peak
-    return 197e12  # assume v5e (the bench fleet) when the kind is opaque
+    return _costmodel.peak_for_device(device).flops
 
 
 def flagship_train_config():
@@ -421,35 +412,22 @@ def _p2p_bench() -> dict:
 
 
 def _peak_hbm_bw(device) -> float:
-    """Per-chip HBM bandwidth by device kind (bytes/s). Decode is
-    BW-bound, so this is the denominator of its roofline.
+    """Per-chip HBM bandwidth (bytes/s) — the decode roofline
+    denominator, from the shared peak table (obs/costmodel.py).
 
     Note: the B=1 decode rung has measured slightly ABOVE 1.0
     pct-of-peak on the bench chip (reported as "TPU v5 lite"), i.e.
-    this table's spec value is conservative for that part. The table
-    stays as-spec for cross-round comparability — read pct-of-peak as
+    the spec value is conservative for that part — read pct-of-peak as
     a relative efficiency index, not a physical bound."""
-    kind = getattr(device, "device_kind", "").lower()
-    if "v5 lite" in kind or "v5e" in kind or "v5lite" in kind:
-        return 819e9
-    if "v4" in kind:
-        return 1228e9
-    if "v5p" in kind or "v5" in kind:
-        return 2765e9
-    if "v6" in kind:
-        return 1640e9
-    return 819e9  # conservative default (v5e-class)
+    return _costmodel.peak_for_device(device).hbm_bytes_s
 
 
 def _decode_step_bytes(cfg, param_bytes: int, b: int, s_pad: int) -> float:
-    """HBM bytes one decode step must move: every parameter byte
-    (weights stream once per token — the defining cost of small-batch
-    decode) plus the FULL padded KV cache (the masked-dense decode
-    attention reads all S slots every step, by construction:
-    models/llama.py _decode_step einsums over s = max_len). Activation
-    traffic at B<=32 is noise next to these two."""
-    kv_bytes = 2 * cfg.n_layers * b * s_pad * cfg.n_kv_heads * cfg.head_dim * 2
-    return param_bytes + kv_bytes
+    """HBM bytes one decode step must move — delegates to the shared
+    cost model (obs/costmodel.py decode_step_bytes: every parameter
+    byte plus the FULL padded KV cache; tests/test_costmodel.py pins
+    the call sites agree)."""
+    return _costmodel.decode_step_bytes(cfg, param_bytes, b, s_pad)
 
 
 def measure_decode(gen_params, cfg, b, t0, max_new, reps=None):

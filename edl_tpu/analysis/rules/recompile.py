@@ -131,13 +131,18 @@ class RecompileHazardRule(Rule):
         self_assigned: Set[int] = set()
         for n in walk_no_nested_functions(fn):
             if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
-                if is_jit_call(n.value) and any(
+                if any(
                     isinstance(t, ast.Attribute)
                     and isinstance(t.value, ast.Name)
                     and t.value.id == "self"
                     for t in n.targets
                 ):
-                    self_assigned.add(id(n.value))
+                    # the jit itself, or a jit nested in a decorator-
+                    # style wrapper call (compilewatch.wrap(jax.jit(f),
+                    # ...)) — still the build-once builder shape
+                    for sub in ast.walk(n.value):
+                        if isinstance(sub, ast.Call) and is_jit_call(sub):
+                            self_assigned.add(id(sub))
 
         def visit(node: ast.AST, in_guard: bool) -> None:
             if isinstance(node, ast.Call) and is_jit_call(node):

@@ -15,6 +15,12 @@ Checks (registration sites are any ``.counter("…")`` / ``.gauge`` /
 never guessed):
 
 * metric names match ``edl_[a-z0-9_]+``;
+* suffix/kind agreement (the Prometheus grammar dashboards assume):
+  counters MUST end ``_total`` and nothing else may; names ending
+  ``_ratio`` / ``_fraction`` MUST be gauges (the hardware-efficiency
+  families — ``edl_bw_util_ratio``, ``edl_kv_occupancy_ratio``,
+  ``edl_slo_goodput_fraction`` — established the convention: a ratio
+  that is secretly a counter sums meaninglessly across a fleet merge);
 * no same-name registration with a different kind, label schema, or
   bucket ladder anywhere in the project (cross-file, reported at the
   later site);
@@ -134,6 +140,35 @@ class TelemetryConventionsRule(Rule):
                                 f"metric '{name}' does not follow the "
                                 "'edl_<snake_case>' naming convention"
                             ),
+                        )
+                    )
+                suffix_msg = None
+                if leaf == "counter" and not name.endswith("_total"):
+                    suffix_msg = (
+                        f"counter '{name}' must end '_total' "
+                        "(Prometheus counter grammar)"
+                    )
+                elif leaf != "counter" and name.endswith("_total"):
+                    suffix_msg = (
+                        f"{leaf} '{name}' ends '_total' but is not a "
+                        "counter — scrapers will rate() it"
+                    )
+                elif leaf != "gauge" and (
+                    name.endswith("_ratio") or name.endswith("_fraction")
+                ):
+                    suffix_msg = (
+                        f"{leaf} '{name}' ends '_ratio'/'_fraction' but "
+                        "is not a gauge — ratios summed across a fleet "
+                        "merge are meaningless"
+                    )
+                if suffix_msg:
+                    findings.append(
+                        Finding(
+                            rule=self.id,
+                            path=ctx.relpath,
+                            line=node.lineno,
+                            col=node.col_offset,
+                            message=suffix_msg,
                         )
                     )
                 self._regs.append(

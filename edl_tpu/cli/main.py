@@ -1044,6 +1044,12 @@ def run_loadgen(args) -> int:
                 pass
         warm.run()
         del warm
+        # warmup paid every program: a compile during the measured
+        # replay is a steady-state recompile — flag it on the
+        # flight-recorder timeline (obs/compilewatch.py)
+        from edl_tpu.obs import compilewatch
+
+        compilewatch.mark_warm()
 
     metrics = ServingMetrics()
     engine = ContinuousBatchingEngine(
@@ -1090,6 +1096,46 @@ def run_loadgen(args) -> int:
         print(json.dumps(report, sort_keys=True))
     else:
         print(slo.render_report(report))
+    return 0
+
+
+def run_profile(args) -> int:
+    """Roofline report (achieved vs peak per phase + the HBM ledger +
+    compile activity) from a live ``/metrics`` endpoint, a committed
+    ``BENCH_r*.json`` round, or ``--dryrun`` (the CI lane: runs a tiny
+    CPU train window + serving workload, self-scrapes, and
+    hard-asserts the efficiency telemetry — non-zero edl_mfu{phase},
+    edl_hbm_bytes{category="kv"}, edl_compile_seconds, and zero
+    obs.recompile events after warmup). Rendering is device-free; only
+    the dryrun imports jax."""
+    from edl_tpu.obs import profile as prof
+
+    if args.dryrun:
+        try:
+            report = prof.run_dryrun(
+                metrics_port=args.metrics_port, steps=args.steps
+            )
+        except AssertionError as e:
+            print(f"PROFILE DRYRUN FAIL: {e}", file=sys.stderr)
+            return 1
+    elif args.source:
+        try:
+            report = prof.report_for_source(args.source, timeout_s=args.timeout)
+        except (OSError, ValueError, KeyError) as e:
+            print(
+                f"cannot profile {args.source!r}: {e}", file=sys.stderr
+            )
+            return 2
+    else:
+        print(
+            "error: need a SOURCE (endpoint or BENCH_r*.json) or --dryrun",
+            file=sys.stderr,
+        )
+        return 2
+    if args.json:
+        print(json.dumps(report, sort_keys=True))
+    else:
+        print(prof.render_report(report))
     return 0
 
 
@@ -1627,6 +1673,40 @@ def build_parser() -> argparse.ArgumentParser:
     lg.add_argument("--mesh", default="", help="as in `edl serve`")
     lg.add_argument("--int8", action="store_true", help="as in `edl serve`")
     lg.set_defaults(fn=run_loadgen)
+
+    pf = sub.add_parser(
+        "profile",
+        help="roofline report: achieved vs peak per phase (edl_mfu / "
+        "edl_bw_util_ratio), the HBM memory ledger, and compile "
+        "telemetry — from a live /metrics endpoint or a BENCH_r*.json",
+    )
+    pf.add_argument(
+        "source", nargs="?", default=None,
+        help="exporter host:port / URL, or a BENCH_r*.json path "
+        "(omit with --dryrun)",
+    )
+    pf.add_argument(
+        "--dryrun", action="store_true",
+        help="CI lane: run a tiny CPU train+serve workload, "
+        "self-scrape, and hard-assert the efficiency telemetry "
+        "(non-zero mfu/ledger/compile series, zero post-warmup "
+        "recompiles)",
+    )
+    pf.add_argument(
+        "--metrics-port", type=int, default=None,
+        help="with --dryrun: expose /metrics during the run and "
+        "scrape it over HTTP instead of in-process (0 = ephemeral)",
+    )
+    pf.add_argument(
+        "--steps", type=int, default=4,
+        help="dryrun train-window steps",
+    )
+    pf.add_argument("--timeout", type=float, default=5.0)
+    pf.add_argument(
+        "--json", action="store_true",
+        help="print the report as one JSON object",
+    )
+    pf.set_defaults(fn=run_profile)
 
     pr = sub.add_parser(
         "predict",

@@ -25,6 +25,8 @@ import numpy as np
 from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec as P
 
+from edl_tpu.obs import compilewatch
+from edl_tpu.obs import costmodel as _costmodel
 from edl_tpu.parallel.mesh import MeshPlan
 
 
@@ -883,6 +885,11 @@ def _generate_program(cfg: LlamaConfig, b: int, t0: int, max_new: int,
         )
         return jnp.swapaxes(toks, 0, 1)  # [B, max_new]
 
+    # compile watch: each cache key is one distinct program — its first
+    # call is timed into edl_compile_seconds{program="llama.generate"}
+    # and flagged as obs.recompile once the process declared warmup over
+    run = compilewatch.wrap(run, "llama.generate")
+
     while len(_generate_programs) >= _GENERATE_PROGRAM_CAP:
         _generate_programs.popitem(last=False)  # evict least-recent
     _generate_programs[cache_key] = run
@@ -893,17 +900,12 @@ def train_flops_per_token(cfg: LlamaConfig, seq: int) -> float:
     """Model FLOPs per trained token (fwd+bwd), the MFU numerator:
     6 × matmul params (embedding lookup excluded, lm_head included)
     plus causal attention 12·L·(T/2)·d_attn. Remat recompute is NOT
-    counted (MFU convention: model FLOPs, not hardware FLOPs)."""
-    hd = cfg.head_dim
-    per_layer = (
-        cfg.d_model * cfg.n_heads * hd  # wq
-        + 2 * cfg.d_model * cfg.n_kv_heads * hd  # wk, wv
-        + cfg.n_heads * hd * cfg.d_model  # wo
-        + 3 * cfg.d_model * cfg.d_ff  # w1, w3, w2
-    )
-    n_matmul = cfg.n_layers * per_layer + cfg.d_model * cfg.vocab
-    attn = 12.0 * cfg.n_layers * (seq / 2.0) * (cfg.n_heads * hd)
-    return 6.0 * n_matmul + attn
+    counted (MFU convention: model FLOPs, not hardware FLOPs).
+
+    The formula itself lives in ``obs/costmodel.py`` — the ONE analytic
+    cost model bench.py, exp_mfu, and the live efficiency gauges share
+    (tests/test_costmodel.py pins the call sites agree)."""
+    return _costmodel.train_flops_per_token(cfg, seq)
 
 
 def make_loss_fn(cfg: LlamaConfig, plan: Optional[MeshPlan] = None, mesh=None):

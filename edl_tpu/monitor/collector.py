@@ -50,6 +50,11 @@ class MonitorSample:
     # empty for training-fleet samples. Same plumbing as training load
     # so an autoscaler can consume either.
     serving: Dict[str, float] = field(default_factory=dict)
+    # hardware-efficiency gauges (obs/costmodel.py efficiency_snapshot:
+    # mfu_<phase>, bw_util_<phase>, hbm_bytes_<category>,
+    # kv_occupancy_ratio) — empty until a process publishes them. Rides
+    # to_record(), so `edl monitor --json` consumers see the roofline.
+    efficiency: Dict[str, float] = field(default_factory=dict)
 
     @property
     def cpu_util(self) -> float:
@@ -71,7 +76,10 @@ class MonitorSample:
         if self.serving and not (
             self.submitted_jobs or self.chip_total or self.cpu_total_milli
         ):
-            return "\n".join(self._serving_lines())
+            return "\n".join(
+                self._serving_lines()
+                + (self._efficiency_lines() if self.efficiency else [])
+            )
         lines = [
             f"SUBMITTED-JOBS: {len(self.submitted_jobs)}",
             f"PENDING-JOBS: {len(self.pending_jobs)}"
@@ -103,7 +111,33 @@ class MonitorSample:
         )
         if self.serving:
             lines.extend(self._serving_lines())
+        if self.efficiency:
+            lines.extend(self._efficiency_lines())
         return "\n".join(lines)
+
+    def _efficiency_lines(self) -> List[str]:
+        e = self.efficiency
+        phases = sorted(
+            k[len("mfu_"):] for k in e if k.startswith("mfu_")
+        )
+        parts = [
+            f"{ph}: mfu={e.get(f'mfu_{ph}', 0.0):.1%}"
+            f" bw={e.get(f'bw_util_{ph}', 0.0):.1%}"
+            for ph in phases
+        ]
+        hbm = {
+            k[len("hbm_bytes_"):]: v
+            for k, v in e.items()
+            if k.startswith("hbm_bytes_") and v
+        }
+        line = "EFFICIENCY: " + "  ".join(parts) if parts else "EFFICIENCY:"
+        if hbm:
+            line += "  hbm " + " ".join(
+                f"{c}={v / (1 << 30):.2f}G" for c, v in sorted(hbm.items())
+            )
+        if e.get("kv_occupancy_ratio"):
+            line += f"  kv_used={e['kv_occupancy_ratio']:.1%}"
+        return [line]
 
     def _serving_lines(self) -> List[str]:
         s = self.serving
@@ -230,10 +264,17 @@ class ServingSource:
         self._snapshot = (
             metrics if callable(metrics) else metrics.snapshot
         )
+        # the engine's efficiency gauges live in the same registry its
+        # ServingMetrics records into; callables fall back to the
+        # process default
+        self._registry = getattr(metrics, "registry", None)
 
     def sample(self) -> MonitorSample:
+        from edl_tpu.obs.costmodel import efficiency_snapshot
+
         s = MonitorSample(ts=time.time())
         s.serving = dict(self._snapshot())
+        s.efficiency = efficiency_snapshot(self._registry)
         return s
 
 

@@ -52,3 +52,20 @@ from edl_tpu.obs.slo import (  # noqa: F401
     compute_goodput,
     default_classes,
 )
+from edl_tpu.obs import compilewatch  # noqa: F401  (compile telemetry)
+from edl_tpu.obs import costmodel  # noqa: F401  (roofline cost model)
+from edl_tpu.obs.costmodel import (  # noqa: F401
+    Cost,
+    CostModel,
+    DevicePeak,
+    EfficiencyMeter,
+    detect_peak,
+    peak_for_device,
+    peak_for_kind,
+)
+from edl_tpu.obs import memledger  # noqa: F401  (device memory ledger)
+from edl_tpu.obs.memledger import (  # noqa: F401
+    MemoryLedger,
+    default_ledger,
+    tree_nbytes,
+)

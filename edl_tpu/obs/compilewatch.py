@@ -1,0 +1,99 @@
+"""Runtime compile observability — the dynamic twin of `edl check`'s
+static recompile-hazard rule.
+
+Every shared jit-program factory (the serving engine's block/prefill
+memo, ``llama._generate_program``, the trainer step factories) wraps
+its compiled callable here. The FIRST invocation of each distinct
+program is timed into ``edl_compile_seconds{program}`` and counted in
+``edl_compiles_total{program}`` — jax jit is lazy, so the first call
+is where trace+compile actually happens, and each memo key IS a
+distinct program, so first-call-per-wrapper measures exactly one
+compile. (The timing includes the first execution; on anything bigger
+than a toy, compile dominates by orders of magnitude.)
+
+After :func:`mark_warm` — called by harnesses once their warmup pass
+has paid the expected compiles — any further compile additionally
+emits an ``obs.recompile`` flight-recorder event (severity ``warn``):
+a steady-state serving loop that compiles is paying seconds of latency
+someone should see on the incident timeline, exactly the hazard class
+the static rule flags at review time. The acceptance gate asserts ZERO
+such events on the steady-state serving loop (`edl profile --dryrun`).
+
+Hot-path cost after the first call: one bool check per invocation.
+Metrics go to the process default registry on purpose — compile
+activity is process-level truth regardless of which private registry
+an engine's serving metrics use.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable
+
+from edl_tpu.obs import metrics as obs_metrics
+
+_lock = threading.Lock()
+_warm = False
+
+
+def mark_warm() -> None:
+    """Declare warmup over: compiles from here on are RE-compiles and
+    land on the flight-recorder timeline."""
+    global _warm
+    with _lock:
+        _warm = True
+
+
+def is_warm() -> bool:
+    with _lock:
+        return _warm
+
+
+def reset() -> None:
+    """Back to warmup (tests)."""
+    global _warm
+    with _lock:
+        _warm = False
+
+
+def wrap(fn: Callable, program: str) -> Callable:
+    """Instrument one compiled program. Transparent to donation and
+    tracing — the wrapper only forwards ``*args``."""
+
+    state = {"done": False}
+    state_lock = threading.Lock()
+
+    def run(*args, **kw):
+        if state["done"]:
+            return fn(*args, **kw)
+        t0 = time.perf_counter()
+        out = fn(*args, **kw)
+        dt = time.perf_counter() - t0
+        with state_lock:
+            if state["done"]:  # lost the race: someone else timed it
+                return out
+            state["done"] = True
+        r = obs_metrics.default_registry()
+        r.histogram(
+            "edl_compile_seconds",
+            "first-call (trace + compile) time per distinct jit program",
+            ("program",),
+        ).observe(dt, program=program)
+        r.counter(
+            "edl_compiles_total",
+            "distinct jit programs compiled, by factory",
+            ("program",),
+        ).inc(program=program)
+        if is_warm():
+            from edl_tpu.obs import events as flight
+
+            flight.emit(
+                "obs.recompile", severity="warn",
+                program=program, seconds=round(dt, 6),
+            )
+        return out
+
+    run.__name__ = f"compilewatch[{program}]"
+    run.__wrapped__ = fn
+    return run

@@ -521,6 +521,48 @@ def ensure_core_series(reg: Optional[MetricsRegistry] = None) -> MetricsRegistry
     r.histogram("edl_checkpoint_save_seconds", "checkpoint write time", ("kind",))
     r.histogram("edl_checkpoint_restore_seconds", "checkpoint read/restore time", ("kind",))
     r.counter("edl_checkpoint_bytes_total", "checkpoint bytes moved", ("op",))
+    # hardware efficiency (obs/costmodel.py, obs/memledger.py,
+    # obs/compilewatch.py — doc/observability.md "Hardware efficiency")
+    r.gauge(
+        "edl_mfu",
+        "achieved model FLOPs/s over peak FLOPs by phase (obs/costmodel.py)",
+        ("phase",),
+    )
+    r.gauge(
+        "edl_bw_util_ratio",
+        "achieved HBM bytes/s over peak bandwidth by phase",
+        ("phase",),
+    )
+    r.counter(
+        "edl_costmodel_flops_total",
+        "analytic model FLOPs completed by phase",
+        ("phase",),
+    )
+    r.counter(
+        "edl_costmodel_hbm_bytes_total",
+        "analytic HBM bytes moved by phase",
+        ("phase",),
+    )
+    r.gauge(
+        "edl_hbm_bytes",
+        "bytes of registered long-lived device allocations by "
+        "category (obs/memledger.py)",
+        ("category",),
+    )
+    r.gauge(
+        "edl_kv_occupancy_ratio",
+        "used KV-cache tokens over capacity across registered engines",
+    )
+    r.histogram(
+        "edl_compile_seconds",
+        "first-call (trace + compile) time per distinct jit program",
+        ("program",),
+    )
+    r.counter(
+        "edl_compiles_total",
+        "distinct jit programs compiled, by factory",
+        ("program",),
+    )
     # tracing bridge (obs/fleet.py bridge_tracer)
     r.histogram("edl_span_seconds", "tracer span durations by name", ("name",))
     r.counter("edl_trace_spans_dropped_total", "spans evicted from the tracer ring buffer")

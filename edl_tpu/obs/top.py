@@ -108,6 +108,43 @@ def summarize(fams: _Fams) -> List[str]:
             f" ({_total(fams, 'edl_slo_goodput_fraction'):.1%} of offered)"
         )
 
+    # hardware-efficiency strip (obs/costmodel.py + obs/memledger.py):
+    # live roofline position per phase + the HBM balance sheet — shown
+    # whenever any process has published efficiency telemetry
+    mfu_by_phase = {
+        labels.get("phase"): v
+        for labels, v in fams.get("edl_mfu", ())
+        if labels.get("phase")
+    }
+    bw_by_phase = {
+        labels.get("phase"): v
+        for labels, v in fams.get("edl_bw_util_ratio", ())
+        if labels.get("phase")
+    }
+    hbm = {
+        labels.get("category"): v
+        for labels, v in fams.get("edl_hbm_bytes", ())
+        if labels.get("category") and v
+    }
+    if any(mfu_by_phase.values()) or any(bw_by_phase.values()) or hbm:
+        parts = [
+            f"{ph}: mfu={mfu_by_phase.get(ph, 0.0):.1%}"
+            f" bw={bw_by_phase.get(ph, 0.0):.1%}"
+            for ph in sorted(set(mfu_by_phase) | set(bw_by_phase))
+            if mfu_by_phase.get(ph) or bw_by_phase.get(ph)
+        ]
+        lines.append("EFFICNCY " + "  ".join(parts))
+        if hbm:
+            gb = lambda v: f"{v / (1 << 30):.2f}G"  # noqa: E731
+            occ = _total(fams, "edl_kv_occupancy_ratio")
+            compiles = _total(fams, "edl_compiles_total")
+            lines.append(
+                "         hbm: "
+                + " ".join(f"{c}={gb(v)}" for c, v in sorted(hbm.items()))
+                + (f"  kv_used={occ:.1%}" if occ else "")
+                + (f"  compiles={compiles:.0f}" if compiles else "")
+            )
+
     nre = _total(fams, "edl_reshard_total")
     if nre:
         rp = _pctls(fams, "edl_reshard_stall_seconds")

@@ -41,7 +41,9 @@ from edl_tpu.train.trainer import (
     make_train_step,
     shard_state,
 )
+from edl_tpu.obs import costmodel as _costmodel
 from edl_tpu.obs import events as flight
+from edl_tpu.obs import memledger
 from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.utils import tracing
 from edl_tpu.utils.logging import Timer, kv_logger
@@ -131,6 +133,8 @@ class ElasticTrainer:
         checkpoint_every_steps: int = 0,
         sync_every: int = 1,
         make_loss: Optional[Callable] = None,
+        flops_per_example: Optional[float] = None,
+        hbm_bytes_per_example: Optional[float] = None,
     ):
         self.loss_fn = loss_fn
         # mesh-aware loss factory ``(plan, mesh) -> loss_fn``, re-invoked
@@ -165,6 +169,23 @@ class ElasticTrainer:
         self._step_fn = None
         self._scale_target: Optional[int] = None
         self.report = TrainReport()
+        # hardware-efficiency observability (obs/costmodel.py): when
+        # the workload declares its analytic cost per example, every
+        # train_steps window publishes edl_mfu{phase="train"} /
+        # edl_bw_util_ratio{phase="train"} from the measured
+        # examples/sec — live roofline telemetry, not a bench-only
+        # number. Per-DEVICE: the gauges are per-chip utilization.
+        self.flops_per_example = flops_per_example
+        self.hbm_bytes_per_example = hbm_bytes_per_example
+        self._eff: Optional[_costmodel.EfficiencyMeter] = None
+        # device memory ledger: this trainer's long-lived HBM (params
+        # + optimizer moments), re-registered on every (re)placement
+        # under stable keys so reshards replace rather than accumulate
+        self._ledger = memledger.default_ledger()
+        self._ledger_owner = f"trainer-{id(self)}"
+        import weakref
+
+        weakref.finalize(self, self._ledger.release_owner, self._ledger_owner)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -183,6 +204,7 @@ class ElasticTrainer:
         self.state = shard_state(host, self.plan, self.mesh, self._pspecs)
         if self._stepper is not None:
             self.state = self._stepper.localize(self.state)
+        self._ledger_register()
         self._host_step = 0
         log.info(
             "elastic trainer started",
@@ -201,6 +223,7 @@ class ElasticTrainer:
         self.state = ckpt.restore(host, self.plan, self.mesh, self._pspecs)
         if self._stepper is not None:
             self.state = self._stepper.localize(self.state)
+        self._ledger_register()
         self._host_step = int(np.asarray(host.step))
         log.info(
             "elastic trainer resumed",
@@ -258,6 +281,20 @@ class ElasticTrainer:
             LocalSyncStepper(loss, self.tx, self.plan, self.mesh)
             if self.sync_every > 1
             else None
+        )
+
+    def _ledger_register(self) -> None:
+        """(Re)register the live state's HBM in the memory ledger —
+        params and optimizer moments, under stable per-trainer keys
+        (replace semantics: reshards and restores cannot drift the
+        edl_hbm_bytes gauges)."""
+        if self.state is None:
+            return
+        self._ledger.register_tree(
+            self._ledger_owner, "params", self.state.params, "params"
+        )
+        self._ledger.register_tree(
+            self._ledger_owner, "opt", self.state.opt_state, "opt"
         )
 
     @property
@@ -343,6 +380,9 @@ class ElasticTrainer:
             if self._stepper is not None:
                 self.state = self._stepper.localize(self.state)
             del old_state
+            # stable keys: the re-placed state REPLACES the ledger
+            # entries — N reshards leave exactly one state's bytes
+            self._ledger_register()
         ev = ReshardEvent(
             from_workers=prev,
             to_workers=target,
@@ -426,6 +466,21 @@ class ElasticTrainer:
                 "edl_train_examples_per_sec",
                 "training throughput over the last report window",
             ).set(self.report.examples_per_sec)
+        if self.flops_per_example and self.report.train_seconds > 0:
+            # live roofline: measured examples/s × the workload's
+            # analytic cost, per chip — the scrapeable twin of the
+            # bench's MFU figure (obs/costmodel.py owns the formulas)
+            # re-resolved per window (get-or-create is dict hits) so a
+            # test's registry swap takes effect, like _record_dispatch
+            self._eff = _costmodel.EfficiencyMeter(registry=reg)
+            eps_per_dev = self.report.examples_per_sec / max(
+                self.n_devices, 1
+            )
+            self._eff.set_rates(
+                "train",
+                eps_per_dev * self.flops_per_example,
+                eps_per_dev * (self.hbm_bytes_per_example or 0.0),
+            )
         return self.report
 
     def _train_steps_inner(

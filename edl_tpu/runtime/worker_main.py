@@ -703,6 +703,9 @@ class ElasticWorker:
         wl = WORKLOADS[cfg.model](cfg)
         self._model_meta = wl.model_meta
         self._eval.eval_fn = wl.eval_fn
+        # workload-declared analytic cost: lets the step loop publish
+        # the live roofline gauge edl_mfu{phase="train"} (obs/costmodel)
+        self._flops_per_example = wl.flops_per_example
         if cfg.eval_dir and wl.eval_fn is None:
             # surface the misconfiguration once: otherwise EDL_EVAL_DIR
             # on a workload without an eval hook is a silent no-op
@@ -1139,6 +1142,14 @@ class ElasticWorker:
             "edl_train_examples_total", "training rows consumed"
         )
         g_loss = reg.gauge("edl_train_loss", "most recent training loss")
+        eff = n_local = None
+        if getattr(self, "_flops_per_example", None):
+            from edl_tpu.obs import costmodel as _cm
+
+            # per-CHIP roofline: this process's rows over its local
+            # devices; the fleet view sums per-worker gauges
+            eff = _cm.EfficiencyMeter(registry=reg)
+            n_local = max(jax.local_device_count(), 1)
 
         go_key = self._k("go", str(epoch))
         sharding = plan.batch_sharding(mesh)
@@ -1196,7 +1207,20 @@ class ElasticWorker:
                 state = new_state
                 c_examples.inc(self._local_rows)
                 g_loss.set(loss)
-                h_step.observe(time.perf_counter() - t_iter)
+                step_wall = time.perf_counter() - t_iter
+                h_step.observe(step_wall)
+                if eff is not None:
+                    from edl_tpu.obs.costmodel import Cost
+
+                    eff.observe(
+                        "train",
+                        Cost(
+                            self._local_rows * self._flops_per_example
+                            / n_local,
+                            0.0,
+                        ),
+                        step_wall,
+                    )
                 if task_id is not None:
                     cl.ack(task_id)
                 if cfg.step_sleep_s:

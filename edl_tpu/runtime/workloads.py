@@ -18,6 +18,7 @@ from typing import Any, Callable, Dict, Optional
 
 import numpy as np
 
+from edl_tpu.obs import costmodel
 from edl_tpu.runtime.worker_config import WorkerConfig
 
 # --------------------------------------------------------------------------
@@ -47,6 +48,10 @@ class Workload:
     # held-out evaluation ``f(params, rows) -> float`` run by the
     # commit leader on every published export (cfg.eval_dir)
     eval_fn: Optional[Callable[[Any, Dict[str, np.ndarray]], float]] = None
+    # analytic model FLOPs per training example (obs/costmodel.py) —
+    # when declared, the worker step loop publishes the live roofline
+    # gauges edl_mfu{phase="train"} from measured examples/s
+    flops_per_example: Optional[float] = None
 
     def loss_for(self, plan, mesh) -> Callable:
         return self.make_loss(plan, mesh) if self.make_loss else self.loss_fn
@@ -107,6 +112,9 @@ def _ctr_workload(cfg: WorkerConfig) -> Workload:
         ctr.make_loss_fn(),
         batch_fn,
         eval_fn=eval_auc,
+        flops_per_example=costmodel.ctr_train_flops_per_example(
+            **({"emb": cfg.emb} if cfg.emb else {})
+        ),
         # architecture record so `edl predict` can score a CTR export
         # offline — THE reference serving artifact
         # (example/ctr/ctr/train.py:169-180). ctr.forward reads its
@@ -171,6 +179,8 @@ def _llama_workload(cfg: WorkerConfig) -> Workload:
         make_loss=lambda plan, mesh: llama.make_loss_fn(mcfg, plan, mesh),
         model_meta=mcfg.to_meta(),
         eval_fn=_lm_ppl_eval(lambda p, t: llama.forward(p, t, mcfg)),
+        flops_per_example=cfg.seq_len
+        * costmodel.train_flops_per_token(mcfg, cfg.seq_len),
     )
 
 
@@ -265,6 +275,9 @@ def _moe_workload(cfg: WorkerConfig) -> Workload:
         pspecs=lambda plan: moe.param_pspecs(mcfg, plan),
         model_meta=mcfg.to_meta(),
         eval_fn=_lm_ppl_eval(lambda p, t: moe.forward(p, t, mcfg)[0]),
+        # MoE: the cost model prices the ACTIVATED (top_k) expert width
+        flops_per_example=cfg.seq_len
+        * costmodel.train_flops_per_token(mcfg, cfg.seq_len),
     )
 
 

@@ -103,6 +103,7 @@ records into goodput-under-SLO.
 from __future__ import annotations
 
 import time
+import weakref
 from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from functools import partial
@@ -113,6 +114,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from edl_tpu.models import llama
+from edl_tpu.obs import compilewatch
+from edl_tpu.obs import costmodel as _cm
+from edl_tpu.obs import memledger
 from edl_tpu.serving.metrics import ServingMetrics
 from edl_tpu.serving.scheduler import (
     AdmissionError,
@@ -164,7 +168,9 @@ def _block_program(
                 sampling=sampling,
             )
 
-        return run
+        # each memo key IS a distinct program — the compile watch times
+        # its first call and flags post-warmup compiles (obs.recompile)
+        return compilewatch.wrap(run, "serve.block")
 
     return _memo(("block", cfg, b, s, horizon, sampling), make)
 
@@ -202,7 +208,7 @@ def _prefill_program(cfg: llama.LlamaConfig, tb: int, sampling: bool):
             eosv = eosv.at[slot].set(eos)
             return t0, tok, pos, active, rem, eosv, kc, vc
 
-        return run
+        return compilewatch.wrap(run, "serve.prefill")
 
     return _memo(("prefill", cfg, tb, sampling), make)
 
@@ -310,6 +316,30 @@ class ContinuousBatchingEngine:
         # request popped from the queue but not yet slotted — requeued
         # at the head if the admission prefill faults
         self._admitting: Optional[Request] = None
+        # hardware-efficiency observability (doc/observability.md
+        # "Hardware efficiency"): the analytic cost model prices each
+        # dispatched program, the efficiency meter turns drained-block
+        # wall time into live edl_mfu{phase}/edl_bw_util_ratio{phase}
+        # gauges, and the memory ledger holds this engine's long-lived
+        # HBM (params / kv / slot_state) under an owner key released
+        # automatically when the engine is garbage-collected.
+        self._ledger = memledger.default_ledger()
+        self._ledger_owner = f"engine-{id(self)}"
+        pbytes = memledger.tree_nbytes(params)
+        self._cost = _cm.CostModel(
+            cfg, peak=_cm.detect_peak(),
+            param_bytes_total=pbytes or None,
+        )
+        self._eff = _cm.EfficiencyMeter(
+            self._cost.peak, registry=self.metrics.registry
+        )
+        # constant per engine: every block runs max_slots rows for
+        # `horizon` steps over the full padded cache (program cost)
+        self._block_cost = self._cost.decode_block(
+            max_slots, horizon, max_len
+        )
+        self._ledger.register(self._ledger_owner, "params", pbytes, "params")
+        weakref.finalize(self, self._ledger.release_owner, self._ledger_owner)
         self._alloc_device_state()
         self._decode = _block_program(
             cfg, max_slots, max_len, horizon, self._sampling
@@ -361,6 +391,22 @@ class ContinuousBatchingEngine:
         # honors donation (CPU/TPU do; a backend that copies instead
         # just loses the in-place win, not correctness)
         self._donates: Optional[bool] = None
+        # ledger re-registration under the SAME keys: a recovery's
+        # realloc REPLACES the entries (donation-/recovery-aware — the
+        # gauge cannot drift across crash/recover cycles; exp_chaos
+        # pins the exact figure), and the efficiency busy-clock resets
+        # so discarded in-flight time is not charged
+        self._ledger.register(
+            self._ledger_owner, "kv",
+            self._kc.nbytes + self._vc.nbytes, "kv",
+        )
+        self._ledger.register(
+            self._ledger_owner, "slot_state",
+            self._dtok.nbytes + self._dpos.nbytes + self._dact.nbytes
+            + self._drem.nbytes + self._deos.nbytes,
+            "slot_state",
+        )
+        self._t_eff_last = self.clock()
 
     # -- request intake -----------------------------------------------------
 
@@ -471,6 +517,18 @@ class ContinuousBatchingEngine:
             emitted += self._admit()
         active_n = self.active_slots
         self.metrics.on_step(active_n, self.max_slots, self.queue.depth)
+        # live KV occupancy: tokens actually resident (prompt +
+        # committed generation, capped at the slot length) over the
+        # allocated capacity — the effective-concurrency-at-fixed-HBM
+        # figure ROADMAP item 1 (paged KV) must move
+        used = sum(
+            min(len(s.prompt) + len(s.generated), self.max_len)
+            for s in self._slots
+            if s is not None
+        )
+        self._ledger.set_kv_usage(
+            self._ledger_owner, used, self.max_slots * self.max_len
+        )
         if active_n:
             self._dispatch_block()
             # double buffer: block k+1 is now on device; drain block k
@@ -573,7 +631,15 @@ class ContinuousBatchingEngine:
             out = np.asarray(blk)
         # dispatch -> drained wall time: the decode-phase granule of
         # the latency decomposition (end-to-end as the host saw it)
-        self.metrics.on_block(self.clock() - t_dispatch)
+        now = self.clock()
+        self.metrics.on_block(now - t_dispatch)
+        # roofline accounting: the block's analytic cost over its busy
+        # window, clipped against the previous drain so the double
+        # buffer cannot charge overlapped device time twice
+        self._eff.observe(
+            "decode", self._block_cost, now - max(self._t_eff_last, t_dispatch)
+        )
+        self._t_eff_last = now
         emitted = 0
         for i in range(self.max_slots):
             sl = self._slots[i]
@@ -722,6 +788,7 @@ class ContinuousBatchingEngine:
         tb = self._bucket(t0)
         toks = np.zeros((1, tb), np.int32)
         toks[0, :t0] = seq
+        t_pf = self.clock()
         prefill = _prefill_program(self.cfg, tb, self._sampling)
         old = (self._dtok, self._dpos, self._dact, self._drem,
                self._deos, self._kc, self._vc)
@@ -753,7 +820,14 @@ class ContinuousBatchingEngine:
             # block later (and any block dispatched before this
             # admission completed on device as a dependency of the
             # prefill)
-            return int(np.asarray(tok0))
+            first = int(np.asarray(tok0))
+            now = self.clock()
+            self._eff.observe(
+                "prefill", self._cost.prefill(tb),
+                now - max(self._t_eff_last, t_pf),
+            )
+            self._t_eff_last = now
+            return first
 
     def _finish(self, slot: int, outcome: str) -> None:
         sl = self._slots[slot]

@@ -19,6 +19,7 @@ import optax
 from flax import struct
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from edl_tpu.obs import compilewatch
 from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.parallel.mesh import MeshPlan
 from edl_tpu.parallel import sharding as shd
@@ -159,11 +160,20 @@ def make_train_step(
             )
             metric_sh = NamedSharding(mesh, P())
             cell.append(
-                jax.jit(
-                    _step,
-                    in_shardings=(state_sh, batch_sh),
-                    out_shardings=(state_sh, {"loss": metric_sh}),
-                    donate_argnums=(0,) if donate else (),
+                # compile watch: the first call (where jit actually
+                # traces + compiles) lands in edl_compile_seconds and,
+                # post-warmup, on the flight-recorder timeline — a
+                # steady-state loop that recompiles (the reshard
+                # recompile aside, which re-enters here by design) is
+                # paying seconds someone should see
+                compilewatch.wrap(
+                    jax.jit(
+                        _step,
+                        in_shardings=(state_sh, batch_sh),
+                        out_shardings=(state_sh, {"loss": metric_sh}),
+                        donate_argnums=(0,) if donate else (),
+                    ),
+                    "train.step",
                 )
             )
         t = time.perf_counter()
@@ -212,14 +222,17 @@ def make_train_multistep(
             batch_sh = jax.tree_util.tree_map(lambda _: stacked, batches)
             metric_sh = NamedSharding(mesh, P())
             cell.append(
-                jax.jit(
-                    _multi,
-                    in_shardings=(state_sh, batch_sh),
-                    out_shardings=(
-                        state_sh,
-                        {"loss": metric_sh, "losses": metric_sh},
+                compilewatch.wrap(
+                    jax.jit(
+                        _multi,
+                        in_shardings=(state_sh, batch_sh),
+                        out_shardings=(
+                            state_sh,
+                            {"loss": metric_sh, "losses": metric_sh},
+                        ),
+                        donate_argnums=(0,) if donate else (),
                     ),
-                    donate_argnums=(0,) if donate else (),
+                    "train.multistep",
                 )
             )
         t = time.perf_counter()
@@ -358,11 +371,14 @@ class LocalSyncStepper:
             out_shardings=grouped,
             donate_argnums=don,
         )
-        self._step = jax.jit(
-            _lstep,
-            in_shardings=(grouped, batch_sh),
-            out_shardings=(grouped, {"loss": replicated}),
-            donate_argnums=don,
+        self._step = compilewatch.wrap(
+            jax.jit(
+                _lstep,
+                in_shardings=(grouped, batch_sh),
+                out_shardings=(grouped, {"loss": replicated}),
+                donate_argnums=don,
+            ),
+            "train.localsync",
         )
 
     def localize(self, state: TrainState) -> TrainState:

@@ -131,11 +131,35 @@ SERVING_PLANS = [
 
 
 def serving_lane(seed, n_requests, horizon=4, events_dir=None):
+    import numpy as _np
+
     from edl_tpu.models import llama
+    from edl_tpu.obs import costmodel as cm
     from edl_tpu.obs import events as flight
+    from edl_tpu.obs import memledger
     from edl_tpu.obs import postmortem as pm
 
     cfg = llama.LlamaConfig.tiny(vocab=256)
+    # the memory-ledger no-drift contract: after ANY number of
+    # crash/recover cycles an engine's KV entry must be EXACTLY one
+    # cache's bytes — _recover -> _alloc_device_state re-registers
+    # under the same key (replace, never add), so recoveries cannot
+    # leak ledger bytes (ISSUE 8 satellite; kv itemsize follows the
+    # engine's cfg.dtype)
+    expected_kv = cm.kv_cache_bytes(
+        cfg, slots=3, max_len=64,
+        bytes_per_el=_np.dtype(cfg.dtype).itemsize,
+    )
+
+    def check_ledger(eng, tag):
+        got = memledger.default_ledger().owner_total(
+            eng._ledger_owner, "kv"
+        )
+        assert got == expected_kv, (
+            f"{tag}: ledger kv bytes drifted across recovery "
+            f"(want {expected_kv:.0f}, got {got:.0f}, "
+            f"recoveries={eng.recoveries})"
+        )
     params = jax.jit(lambda: llama.init_params(jax.random.PRNGKey(1), cfg))()
     rng = np.random.RandomState(seed)
     reqs = build_workload(n_requests, cfg.vocab, rng)
@@ -150,6 +174,7 @@ def serving_lane(seed, n_requests, horizon=4, events_dir=None):
     ref = {rid: r.tokens for rid, r in ref_eng.results.items()}
     assert len(ref) == len(reqs), "fault-free run lost requests"
     assert ref_eng.recoveries == 0
+    check_ledger(ref_eng, "faultfree")
     # postmortem pass 1: the fault-free timeline must be incident-free
     issues = pm.verify_no_incidents(recorder.records())
     assert not issues, f"fault-free lane shows incidents: {issues}"
@@ -182,6 +207,7 @@ def serving_lane(seed, n_requests, horizon=4, events_dir=None):
         # bounded recovery: one pass per injected crash, and no request
         # burned more than its per-request budget
         assert 0 < eng.recoveries <= fired, (name, eng.recoveries, fired)
+        check_ledger(eng, name)  # kv bytes exact after every recovery
         snap = eng.metrics.snapshot()
         assert snap["recoveries"] == eng.recoveries
         # postmortem pass 2: every injected fault must chain into a

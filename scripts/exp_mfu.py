@@ -32,10 +32,18 @@ from edl_tpu.train.trainer import (
     stack_batches,
 )
 
+from edl_tpu.obs import costmodel as _costmodel
+
 T = 2048
 STEPS_PER_DISPATCH = 2
 DISPATCHES = 4
-PEAK = 197e12  # v5e bf16
+
+
+def _peak() -> float:
+    """bf16 peak of the local chip from the shared table
+    (obs/costmodel.py) — this script hard-coded the v5e figure until
+    the cost model became the one source of device math."""
+    return _costmodel.peak_for_device(jax.devices()[0]).flops
 
 
 def run_variant(per_chip: int, policy: str, plan, mesh, rng) -> float:
@@ -97,7 +105,7 @@ def run_variant(per_chip: int, policy: str, plan, mesh, rng) -> float:
         fpt = llama.train_flops_per_token(cfg, T)
         print(
             f"b{per_chip}:{policy:5s}  {rate:9.0f} tok/s/chip  "
-            f"mfu={rate * fpt / PEAK:.4f}  compile={compile_s:.0f}s",
+            f"mfu={rate * fpt / _peak():.4f}  compile={compile_s:.0f}s",
             flush=True,
         )
         return rate

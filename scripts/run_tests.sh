@@ -131,6 +131,23 @@ PY
 rm -rf "$LGDIR"
 t7=$(date +%s)
 echo "== phase 7 done in $((t7 - t6))s (rc=$rc7) =="
-echo "== total $((t7 - t0))s =="
 
-[ "$rc0" -eq 0 ] && [ "$rc1" -eq 0 ] && [ "$rc2" -eq 0 ] && [ "$rc3" -eq 0 ] && [ "$rc4" -eq 0 ] && [ "$rc5" -eq 0 ] && [ "$rc6" -eq 0 ] && [ "$rc7" -eq 0 ]
+echo "== phase 8: hardware-efficiency profile + perf-regression gate =="
+# `edl profile --dryrun` runs a tiny CPU train window + serving
+# workload and HARD-ASSERTS the efficiency telemetry end to end:
+# non-zero edl_mfu{phase} for train/prefill/decode, non-zero
+# edl_bw_util_ratio, edl_hbm_bytes{category="kv"} on the memory
+# ledger, edl_compile_seconds recorded, and ZERO obs.recompile events
+# on the steady-state serving loop after warmup. Then the perf gate
+# checks the committed BENCH_r* trajectory is internally regression-
+# free under the per-metric tolerances (the same code CI would use to
+# gate a fresh bench round).
+rc8=0
+JAX_PLATFORMS=cpu python -m edl_tpu.cli profile --dryrun --metrics-port 0 \
+    || rc8=1
+python scripts/perf_gate.py || rc8=1
+t8=$(date +%s)
+echo "== phase 8 done in $((t8 - t7))s (rc=$rc8) =="
+echo "== total $((t8 - t0))s =="
+
+[ "$rc0" -eq 0 ] && [ "$rc1" -eq 0 ] && [ "$rc2" -eq 0 ] && [ "$rc3" -eq 0 ] && [ "$rc4" -eq 0 ] && [ "$rc5" -eq 0 ] && [ "$rc6" -eq 0 ] && [ "$rc7" -eq 0 ] && [ "$rc8" -eq 0 ]

@@ -366,6 +366,28 @@ def test_telemetry_metric_name_and_event_kind(tmp_path):
     assert len(rep.findings) == 2
 
 
+def test_telemetry_suffix_kind_conventions(tmp_path):
+    """Counters must end _total; nothing else may; _ratio/_fraction
+    must be gauges (the hardware-efficiency families' convention)."""
+    rep = run_on(tmp_path, """
+    def instrument(reg):
+        reg.counter("edl_widgets", "counter without _total")
+        reg.gauge("edl_things_total", "gauge posing as a counter")
+        reg.histogram("edl_kv_occupancy_ratio", "ratio as histogram")
+        reg.counter("edl_ok_total", "fine")
+        reg.gauge("edl_bw_util_ratio", "fine", ("phase",))
+        reg.gauge("edl_goodput_fraction", "fine")
+        reg.histogram("edl_step_seconds", "fine")
+    """, rules=["telemetry-conventions"])
+    msgs = [f.message for f in rep.findings]
+    assert len(msgs) == 3, msgs
+    assert any("must end '_total'" in m for m in msgs)
+    assert any("ends '_total' but is not a counter" in m for m in msgs)
+    assert any(
+        "ends '_ratio'/'_fraction' but is not a gauge" in m for m in msgs
+    )
+
+
 def test_telemetry_conflicting_registration(tmp_path):
     rep = run_on(tmp_path, """
     def a(reg):

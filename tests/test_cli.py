@@ -74,6 +74,39 @@ def test_validate_command(tmp_path, capsys):
     assert "workers=2-10" in out and "elastic=True" in out
 
 
+def test_profile_renders_bench_roofline(tmp_path, capsys):
+    """`edl profile BENCH.json` — the offline roofline twin, fully
+    device-free (no jax import on this path)."""
+    doc = {
+        "parsed": {
+            "mfu": 0.53, "int8_mfu": 0.59, "peak_tflops": 197.0,
+            "decode_ladder": [
+                {"b": 1, "decode_pct_peak_bw": 0.93,
+                 "decode_tokens_per_sec": 400.0},
+                {"b": 8, "decode_pct_peak_bw": -1.0},  # sentinel: hidden
+            ],
+            "prefill_s": 0.17, "flagship_state_gb": 3.5,
+            "compile_s": 2.9,
+        }
+    }
+    p = tmp_path / "BENCH_rXX.json"
+    p.write_text(json.dumps(doc))
+    assert main(["profile", str(p)]) == 0
+    out = capsys.readouterr().out
+    assert "EDL ROOFLINE" in out and "train" in out
+    assert "53.0%" in out and "93.0%" in out
+    assert "decode_b8" not in out  # sentinel rung stays hidden
+    # --json round-trips
+    assert main(["profile", str(p), "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["phases"]["train"]["mfu"] == 0.53
+    assert rep["phases"]["decode_b1"]["bw_util"] == 0.93
+    # bad sources exit 2 with a clean message
+    assert main(["profile", "definitely-not-listening:1"]) == 2
+    assert "cannot profile" in capsys.readouterr().err
+    assert main(["profile"]) == 2
+
+
 def test_validate_rejects_elastic_without_ft(tmp_path, capsys):
     p = tmp_path / "bad.yaml"
     p.write_text(

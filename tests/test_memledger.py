@@ -1,0 +1,171 @@
+"""Device memory ledger (edl_tpu/obs/memledger.py): replace-on-
+reregister semantics, owner-scoped release, KV occupancy, the serving
+engine's registration (incl. the crash/recover no-drift contract and
+finalize-on-GC), and the EFFICIENCY surfaces (collector sample,
+edl top strip)."""
+
+import gc
+
+import numpy as np
+import pytest
+
+import jax
+
+from edl_tpu.models import llama
+from edl_tpu.obs import costmodel as cm
+from edl_tpu.obs import memledger
+from edl_tpu.obs import metrics as om
+
+
+def test_register_replace_release_semantics():
+    reg = om.MetricsRegistry()
+    led = memledger.MemoryLedger(registry=reg)
+    led.register("a", "kv", 100, "kv")
+    led.register("b", "kv", 50, "kv")
+    assert led.total("kv") == 150
+    assert reg.get("edl_hbm_bytes").value(category="kv") == 150
+    # same key REPLACES (the recovery realloc shape), never adds
+    led.register("a", "kv", 120, "kv")
+    assert led.total("kv") == 170
+    # re-register under a NEW category moves the bytes
+    led.register("a", "kv", 80, "kv2")
+    assert led.total("kv") == 50 and led.total("kv2") == 80
+    assert reg.get("edl_hbm_bytes").value(category="kv") == 50
+    assert led.release("b", "kv") == 50
+    assert led.total("kv") == 0
+    assert led.release("b", "kv") == 0  # absent: no-op
+    assert led.owner_total("a") == 80
+
+
+def test_owner_release_and_kv_occupancy():
+    reg = om.MetricsRegistry()
+    led = memledger.MemoryLedger(registry=reg)
+    led.register("e1", "kv", 100, "kv")
+    led.register("e1", "params", 200, "params")
+    led.set_kv_usage("e1", 30, 100)
+    led.set_kv_usage("e2", 10, 100)
+    assert led.kv_occupancy() == pytest.approx(0.2)
+    assert reg.get("edl_kv_occupancy_ratio").value() == pytest.approx(0.2)
+    assert led.release_owner("e1") == 300
+    assert led.total() == 0
+    # e1's usage is gone too; e2's remains
+    assert led.kv_occupancy() == pytest.approx(0.1)
+    assert led.categories() == {}
+
+
+def test_tree_nbytes_walks_nested_structures():
+    a = np.zeros((4, 4), np.float32)  # 64 bytes
+    tree = {"p": {"w": a, "records": {"q8": np.zeros(8, np.int8),
+                                      "s8": np.zeros(2, np.float32)}},
+            "l": [a, (a, None)], "scalar": 3}
+    assert memledger.tree_nbytes(tree) == 64 * 3 + 8 + 8
+    assert memledger.tree_nbytes(None) == 0
+
+
+# ---------------------------------------------------------------------------
+# engine integration
+
+
+def _tiny_engine(**kw):
+    from edl_tpu.serving.engine import ContinuousBatchingEngine
+
+    cfg = llama.LlamaConfig.tiny(vocab=128)
+    params = jax.jit(lambda: llama.init_params(jax.random.PRNGKey(0), cfg))()
+    eng = ContinuousBatchingEngine(
+        params, cfg, max_slots=2, max_len=32, horizon=4, **kw
+    )
+    return eng, cfg
+
+
+def test_engine_registers_exact_kv_bytes_and_releases_on_gc():
+    led = memledger.default_ledger()
+    eng, cfg = _tiny_engine()
+    owner = eng._ledger_owner
+    expected = cm.kv_cache_bytes(
+        cfg, slots=2, max_len=32,
+        bytes_per_el=np.dtype(cfg.dtype).itemsize,
+    )
+    assert led.owner_total(owner, "kv") == expected
+    assert led.owner_total(owner, "params") > 0
+    assert led.owner_total(owner, "slot_state") > 0
+    del eng
+    gc.collect()
+    assert led.owner_total(owner) == 0  # finalize released everything
+
+
+def test_engine_kv_bytes_do_not_drift_across_recovery():
+    """The ISSUE 8 fix contract: _recover -> _alloc_device_state
+    re-registers under the same key, so edl_hbm_bytes{category=kv}
+    stays EXACTLY one cache across crash/recover cycles."""
+    from edl_tpu.utils import faults
+
+    led = memledger.default_ledger()
+    eng, cfg = _tiny_engine(max_recoveries=3)
+    expected = led.owner_total(eng._ledger_owner, "kv")
+    assert expected > 0
+    for i in range(3):
+        eng.submit(f"r{i}", [1 + i, 2, 3], 10)
+    faults.arm("serve.dispatch:raise@n=2", seed=0)
+    try:
+        res = eng.run()
+    finally:
+        faults.disarm()
+    assert eng.recoveries >= 1
+    assert all(r.outcome in ("done", "eos") for r in res.values())
+    assert led.owner_total(eng._ledger_owner, "kv") == expected
+
+
+def test_engine_kv_occupancy_rises_and_clears():
+    led = memledger.default_ledger()
+    eng, _ = _tiny_engine()
+    eng.submit("r0", [1, 2, 3, 4], 20)
+    for _ in range(2):
+        eng.step()
+    assert led.kv_occupancy() > 0
+    eng.run()
+    eng.step()  # idle step refreshes usage to zero live tokens
+    assert led.owner_total(eng._ledger_owner, "kv") > 0  # cache still held
+    del eng
+    gc.collect()
+
+
+def test_crosscheck_shape():
+    xc = memledger.default_ledger().crosscheck()
+    if xc is None:
+        pytest.skip("jax.live_arrays unavailable")
+    assert set(xc) == {"ledger_bytes", "live_bytes", "unaccounted_bytes"}
+
+
+# ---------------------------------------------------------------------------
+# surfaces: collector EFFICIENCY + edl top strip
+
+
+def test_serving_source_sample_carries_efficiency():
+    from edl_tpu.monitor.collector import ServingSource
+    from edl_tpu.serving.metrics import ServingMetrics
+
+    reg = om.MetricsRegistry()
+    metrics = ServingMetrics(registry=reg)
+    meter = cm.EfficiencyMeter(cm.DevicePeak("t", 1e12, 1e11), registry=reg)
+    meter.set_rates("decode", 5e11, 5e10)
+    s = ServingSource(metrics).sample()
+    assert s.efficiency["mfu_decode"] == pytest.approx(0.5)
+    assert "EFFICIENCY" in s.render()
+    assert s.to_record()["efficiency"]["bw_util_decode"] == pytest.approx(0.5)
+
+
+def test_top_renders_efficiency_strip():
+    from edl_tpu.obs.top import summarize
+
+    reg = om.MetricsRegistry()
+    meter = cm.EfficiencyMeter(cm.DevicePeak("t", 1e12, 1e11), registry=reg)
+    meter.set_rates("decode", 5e11, 5e10)
+    led = memledger.MemoryLedger(registry=reg)
+    led.register("e", "kv", 3 << 30, "kv")
+    led.set_kv_usage("e", 61, 100)
+    fams = om.parse_prometheus_text(reg.render())
+    text = "\n".join(summarize(fams))
+    assert "EFFICNCY" in text
+    assert "decode: mfu=50.0%" in text
+    assert "kv=3.00G" in text
+    assert "kv_used=61.0%" in text

@@ -1,0 +1,130 @@
+"""Perf-regression gate (scripts/perf_gate.py): synthetic improving /
+regressing / noisy trajectories, the empty-trajectory bootstrap,
+sentinel and config-mismatch skipping, and the committed BENCH_r*
+trajectory itself (the CI phase-8 invocation, run in-process).
+jax-free."""
+
+import json
+import os
+
+from scripts.perf_gate import (
+    METRICS,
+    MetricSpec,
+    gate,
+    load_rounds,
+    main,
+    render,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SPECS = {
+    "tps": MetricSpec(+1, 0.10, "config"),
+    "stall_s": MetricSpec(-1, 0.20),
+}
+
+
+def r(tps=None, stall=None, config="c1", rnd="r?"):
+    d = {"_round": rnd, "config": config}
+    if tps is not None:
+        d["tps"] = tps
+    if stall is not None:
+        d["stall_s"] = stall
+    return d
+
+
+def verdict(report, metric):
+    return next(v for v in report.verdicts if v.metric == metric)
+
+
+def test_improving_trajectory_passes():
+    traj = [r(tps=100, stall=2.0, rnd="r1"), r(tps=120, stall=1.5, rnd="r2")]
+    rep = gate(traj, r(tps=130, stall=1.2), metrics=SPECS)
+    assert rep.ok
+    v = verdict(rep, "tps")
+    assert v.status == "pass" and v.reference == 120 and v.reference_round == "r2"
+    assert verdict(rep, "stall_s").reference == 1.5
+
+
+def test_regression_fails_both_directions():
+    traj = [r(tps=100, stall=1.0, rnd="r1")]
+    rep = gate(traj, r(tps=80, stall=1.5), metrics=SPECS)
+    assert not rep.ok
+    assert {v.metric for v in rep.failed} == {"tps", "stall_s"}
+    # renders the failures
+    assert "FAIL" in render(rep)
+
+
+def test_noise_within_tolerance_passes():
+    traj = [r(tps=100, stall=1.0, rnd="r1")]
+    rep = gate(traj, r(tps=91, stall=1.19), metrics=SPECS)  # -9% / +19%
+    assert rep.ok, [v.detail for v in rep.failed]
+
+
+def test_empty_trajectory_bootstraps():
+    rep = gate([], r(tps=100, stall=1.0), metrics=SPECS)
+    assert rep.ok
+    assert {v.status for v in rep.verdicts} == {"bootstrap"}
+
+
+def test_sentinel_values_are_skipped_not_passed():
+    traj = [r(tps=100, rnd="r1")]
+    cand = r(stall=1.0)
+    cand["tps"] = -1.0  # the bench's failed-measurement sentinel
+    rep = gate(traj, cand, metrics=SPECS)
+    assert verdict(rep, "tps").status == "skipped"
+    # a sentinel PRIOR is ignored too — never a reference of -1
+    traj2 = [r(rnd="r1"), r(tps=100, rnd="r2")]
+    traj2[0]["tps"] = -1.0
+    rep2 = gate(traj2, r(tps=95), metrics=SPECS)
+    v = verdict(rep2, "tps")
+    assert v.status == "pass" and v.reference == 100
+
+
+def test_config_mismatch_is_incomparable():
+    # a big "regression" vs a DIFFERENT measurement config bootstraps
+    traj = [r(tps=100000, config="old", rnd="r1")]
+    rep = gate(traj, r(tps=100, config="new"), metrics=SPECS)
+    assert verdict(rep, "tps").status == "bootstrap"
+    # the real shape: BENCH_r01's llama figure predates llama_config
+    traj2 = [{"_round": "r1", "tps": 100000}]  # no config key at all
+    rep2 = gate(traj2, r(tps=100, config="new"), metrics=SPECS)
+    assert verdict(rep2, "tps").status == "bootstrap"
+
+
+def test_committed_trajectory_passes_and_synthetic_regression_fails():
+    rounds = load_rounds(REPO)
+    assert len(rounds) >= 5, "committed BENCH_r*.json rounds missing"
+    cand, traj = rounds[-1], rounds[:-1]
+    rep = gate(traj, cand)
+    assert rep.ok, [v.detail for v in rep.failed]
+    # the gate is not vacuous: >= 8 real comparisons happened
+    assert sum(1 for v in rep.verdicts if v.status == "pass") >= 8
+    # a synthetically-regressed r05 (MFU -30%, CTR -30%) must FAIL
+    bad = dict(cand)
+    bad["mfu"] = cand["mfu"] * 0.7
+    bad["value"] = cand["value"] * 0.7
+    rep2 = gate(traj, bad)
+    assert {v.metric for v in rep2.failed} >= {"mfu", "value"}
+
+
+def test_cli_main_json_and_exit_codes(tmp_path, capsys):
+    assert main(["--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["ok"] is True
+    # a regressed candidate file fails with exit 1
+    rounds = load_rounds(REPO)
+    bad = dict(rounds[-1])
+    bad["mfu"] = bad["mfu"] * 0.5
+    p = tmp_path / "BENCH_bad.json"
+    p.write_text(json.dumps({"parsed": bad}))
+    assert main(["--candidate", str(p)]) == 1
+
+
+def test_gated_catalog_covers_the_headline_metrics():
+    for name in ("value", "mfu", "decode_pct_peak_bw",
+                 "reshard_stall_s", "p2p_bw_gbs", "serving_goodput_rps"):
+        assert name in METRICS
+    # direction sanity: stalls are lower-better
+    assert METRICS["reshard_stall_s"].direction == -1
+    assert METRICS["mfu"].direction == +1
