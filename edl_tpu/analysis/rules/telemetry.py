@@ -27,12 +27,19 @@ never guessed):
 * literal event kinds in ``emit("…")`` match ``site.verb``
   (``[a-z0-9_]+\\.[a-z0-9_]+``);
 * every literal ``fault_point("site")`` site appears somewhere in
-  tests/ or scripts/ (a chaos plan, harness, or test).
+  tests/ or scripts/ (a chaos plan, harness, or test);
+* the distributed-trace context keys (``trace_id`` / ``span_id`` /
+  ``parent_id``) are only read/written through the
+  ``obs/disttrace.py`` helpers — a hand-rolled ``d["trace_id"]``,
+  ``.get("span_id")`` or ``{"parent_id": …}`` literal anywhere else
+  forks the wire format the fleet merge and flow-link matcher depend
+  on (inject/extract/ids_of are the sanctioned accessors).
 """
 
 from __future__ import annotations
 
 import ast
+import os
 import re
 from typing import Dict, Iterable, List, Optional, Tuple
 
@@ -43,6 +50,11 @@ _METRIC_RE = re.compile(r"^edl_[a-z0-9_]+$")
 _KIND_RE = re.compile(r"^[a-z0-9_]+\.[a-z0-9_]+$")
 _REG_KINDS = {"counter", "gauge", "histogram"}
 _EMIT_RECEIVERS = {"events", "flight", "recorder", "rec", "self"}
+# trace-context wire keys: owned by obs/disttrace.py (inject/extract/
+# ids_of); hand-rolled dict access anywhere else is a finding
+_TRACE_KEYS = {"trace_id", "span_id", "parent_id"}
+_TRACE_HOME = "obs/disttrace.py"
+_DICT_METHODS = {"get", "pop", "setdefault"}
 
 
 def _const_str(node: ast.AST) -> Optional[str]:
@@ -112,9 +124,55 @@ class TelemetryConventionsRule(Rule):
         self._regs: List[_Registration] = []
         self._fault_sites: List[Tuple[str, str, int]] = []  # (site, path, line)
 
+    def _trace_key_finding(self, ctx, node, key, how) -> Finding:
+        return Finding(
+            rule=self.id,
+            path=ctx.relpath,
+            line=node.lineno,
+            col=node.col_offset,
+            message=(
+                f"hand-rolled trace-context key {key!r} ({how}) — "
+                "trace_id/span_id/parent_id are only read/written "
+                "through the obs/disttrace helpers "
+                "(inject/extract/ids_of), so the wire format stays "
+                "in one place"
+            ),
+        )
+
     def check_module(self, ctx: ModuleCtx) -> Iterable[Finding]:
         findings: List[Finding] = []
+        trace_home = ctx.relpath.replace(os.sep, "/").endswith(_TRACE_HOME)
         for node in ast.walk(ctx.tree):
+            if not trace_home:
+                # hand-rolled trace-key access outside disttrace.py:
+                # subscripts, dict-method string args, dict literals
+                if (
+                    isinstance(node, ast.Subscript)
+                    and (key := _const_str(node.slice)) in _TRACE_KEYS
+                ):
+                    findings.append(
+                        self._trace_key_finding(ctx, node, key, "subscript")
+                    )
+                elif isinstance(node, ast.Dict):
+                    for k in node.keys:
+                        if (key := _const_str(k)) in _TRACE_KEYS:
+                            findings.append(
+                                self._trace_key_finding(
+                                    ctx, k, key, "dict literal"
+                                )
+                            )
+                elif (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _DICT_METHODS
+                    and node.args
+                    and (key := _const_str(node.args[0])) in _TRACE_KEYS
+                ):
+                    findings.append(
+                        self._trace_key_finding(
+                            ctx, node, key, f".{node.func.attr}()"
+                        )
+                    )
             if not isinstance(node, ast.Call):
                 continue
             func = node.func

@@ -424,6 +424,56 @@ def run_postmortem(args) -> int:
     return 0
 
 
+def run_trace(args) -> int:
+    """Fetch or load a (merged fleet) trace and print the critical
+    path of a step, reshard epoch, or served request — the longest
+    causal chain of spans with per-hop durations and gaps
+    (obs/disttrace.critical_path). ``source`` is a chrome-trace JSON
+    path or an exporter URL / host:port (scrapes /trace — against a
+    coordinator that is the offset-corrected fleet merge). Device-free:
+    pure trace-document analysis."""
+    import json as _json
+    import os as _os
+
+    from edl_tpu.obs import disttrace
+
+    src = args.source
+    try:
+        if _os.path.exists(src):
+            with open(src) as f:
+                doc = _json.load(f)
+        else:
+            from edl_tpu.obs.exporter import scrape
+
+            doc = _json.loads(scrape(src, "/trace", timeout_s=args.timeout))
+    except (OSError, ValueError) as e:
+        print(f"cannot load trace from {src!r}: {e}", file=sys.stderr)
+        return 2
+    n_spans = sum(1 for e in doc.get("traceEvents", ()) if e.get("ph") == "X")
+    workers = doc.get("workers") or []
+    flows = doc.get("flow_links", 0)
+    print(
+        f"trace: {n_spans} spans"
+        + (f" from {len(workers)} processes ({', '.join(workers)})"
+           if workers else "")
+        + (f", {flows} flow links" if flows else "")
+    )
+    hops = disttrace.critical_path(
+        doc, rid=args.rid, step=args.step,
+        reshard_epoch=args.reshard_epoch, trace_id=args.trace_id,
+    )
+    if args.json:
+        print(_json.dumps({"hops": hops, "spans": n_spans,
+                           "workers": workers, "flow_links": flows}))
+    else:
+        print(disttrace.render_critical_path(hops))
+    if args.assert_critical_path and not hops:
+        print("TRACE FAIL: empty critical path for the given filter",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
 def run_check(args) -> int:
     """Project-invariant static analysis (edl_tpu/analysis/): the five
     rules — donation-safety, lockset-race, recompile-hazard,
@@ -1398,6 +1448,45 @@ def build_parser() -> argparse.ArgumentParser:
         "degradation (the fault-free CI lane)",
     )
     pmn.set_defaults(fn=run_postmortem)
+
+    trc = sub.add_parser(
+        "trace",
+        help="fetch/load a (merged fleet) trace and print the "
+        "critical path of a step, reshard epoch, or request",
+    )
+    trc.add_argument(
+        "source",
+        help="chrome-trace JSON path or an exporter URL / host:port "
+        "(scrapes /trace; a coordinator endpoint serves the "
+        "offset-corrected fleet merge)",
+    )
+    trc.add_argument(
+        "--rid", default=None,
+        help="critical path of this served request (matches span "
+        "rid/rids attrs — the same correlation key as /events?rid=)",
+    )
+    trc.add_argument(
+        "--step", type=int, default=None,
+        help="critical path of this training step",
+    )
+    trc.add_argument(
+        "--reshard-epoch", type=int, default=None,
+        help="critical path of this reshard (selects the derived "
+        "reshard trace root)",
+    )
+    trc.add_argument(
+        "--trace-id", default=None, help="select one trace explicitly",
+    )
+    trc.add_argument("--json", action="store_true",
+                     help="machine-readable hops")
+    trc.add_argument("--timeout", type=float, default=5.0)
+    trc.add_argument(
+        "--assert-critical-path", action="store_true",
+        help="exit 1 when the filter selects no spans (the CI gate: "
+        "a fleet trace that cannot answer 'where did the time go' "
+        "is a regression)",
+    )
+    trc.set_defaults(fn=run_trace)
 
     ck = sub.add_parser(
         "check",

@@ -34,11 +34,23 @@ from edl_tpu.obs.fleet import (  # noqa: F401
     MetricsPusher,
     aggregate_snapshots,
     bridge_tracer,
+    clock_key,
     collect_fleet,
     collect_fleet_events,
+    collect_fleet_trace,
     events_key,
+    load_clock_offsets,
     metrics_key,
     registry_from_sample,
+    straggler_pass,
+    trace_key,
+)
+from edl_tpu.obs import disttrace  # noqa: F401  (distributed tracing)
+from edl_tpu.obs.disttrace import (  # noqa: F401
+    ClockSync,
+    TraceContext,
+    critical_path,
+    merge_fleet_trace,
 )
 from edl_tpu.obs import events  # noqa: F401  (flight recorder)
 from edl_tpu.obs.events import (  # noqa: F401

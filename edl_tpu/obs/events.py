@@ -55,6 +55,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Deque, Dict, Iterable, List, Optional
 
+from edl_tpu.obs import disttrace
 from edl_tpu.utils import logging as edl_logging
 
 __all__ = [
@@ -158,8 +159,15 @@ class FlightRecorder:
             "rid": rid, "step": step, "reshard_epoch": reshard_epoch,
             "site": site, "worker": worker,
         }
+        # active distributed-trace context (obs/disttrace): events on a
+        # traced path carry the enclosing span's trace/span ids, which
+        # is how /events?rid= and /trace agree on one correlation key.
+        # One contextvar read when no trace is active.
+        tctx = disttrace.ctx_corr()
         with self._lock:
             corr = dict(self._context)
+            if tctx:
+                corr.update(tctx)
             corr.update((k, v) for k, v in explicit.items() if v is not None)
             self._seq += 1
             ev = Event(self._seq, t_wall, t_mono, kind, severity, corr, attrs)
@@ -297,16 +305,20 @@ class FlightRecorder:
             for e in self.events()
         ]
 
-    def to_chrome_doc(self, tracer=None) -> Dict[str, Any]:
+    def to_chrome_doc(
+        self, tracer=None, since_seq: int = 0, last_n=None
+    ) -> Dict[str, Any]:
         """The tracer's chrome-trace document with this recorder's
         events merged in as instant events — one Perfetto load shows
         spans AND the decisions between them. Served by the exporter's
-        ``/trace``."""
+        ``/trace``. ``since_seq``/``last_n`` bound the SPAN window
+        (tracer-side paging; instant markers are comparatively few and
+        ride along whole)."""
         if tracer is None:
             from edl_tpu.utils import tracing
 
             tracer = tracing.tracer()
-        doc = tracer.to_chrome_doc()
+        doc = tracer.to_chrome_doc(since_seq=since_seq, last_n=last_n)
         doc["traceEvents"].extend(self.to_chrome_events(tracer))
         with self._lock:
             doc["eventsDropped"] = self.dropped

@@ -6,7 +6,12 @@
 * ``/trace``    — the process tracer's chrome://tracing JSON (load in
   Perfetto / chrome://tracing) with the flight recorder's events
   merged in as instant markers, and the ring-buffer ``dropped`` count
-  in the metadata;
+  in the metadata; ``?since=<seq>`` / ``?n=`` bound the span window
+  (the ``/events`` paging mirror — an incremental puller reads
+  ``max_seq`` from the metadata event and fetches only the delta on
+  the next cadence tick). A ``trace_source`` callable replaces the
+  local document entirely — the coordinator serves the offset-
+  corrected FLEET merge here (obs/disttrace.py);
 * ``/events``   — the flight recorder's event log as JSONL, filterable
   by ``?rid=``, ``?kind=``, ``?severity=`` and bounded by ``?n=``
   (obs/events.py; the coordinator serves the worker-labeled fleet
@@ -49,6 +54,9 @@ class MetricsExporter:
     ``events_source`` is a zero-arg callable returning event RECORDS
     (dicts) for ``/events`` — defaults to the process flight
     recorder; the coordinator passes its fleet-union collector.
+    ``trace_source`` is a zero-arg callable returning a chrome-trace
+    document for ``/trace`` — defaults to the local tracer+recorder
+    merge; the coordinator passes the fleet trace merge.
     """
 
     def __init__(
@@ -59,6 +67,7 @@ class MetricsExporter:
         host: str = "127.0.0.1",
         tracer=None,
         events_source: Optional[Callable[[], List[dict]]] = None,
+        trace_source: Optional[Callable[[], dict]] = None,
     ):
         if source is None:
             from edl_tpu.obs.metrics import default_registry
@@ -79,6 +88,7 @@ class MetricsExporter:
 
             events_source = lambda: _events.default_recorder().records()  # noqa: E731
         self._events = events_source
+        self._trace_source = trace_source
         self._host = host
         self._want_port = port
         self._t0 = time.monotonic()
@@ -112,7 +122,7 @@ class MetricsExporter:
                         ctype = CONTENT_TYPE_METRICS
                     elif path == "/trace":
                         body = json.dumps(
-                            exporter.render_trace()
+                            exporter.render_trace(parse_qs(parts.query))
                         ).encode()
                         ctype = "application/json"
                     elif path == "/events":
@@ -186,15 +196,30 @@ class MetricsExporter:
     def render_metrics(self) -> str:
         return self._collect().render()
 
-    def render_trace(self) -> dict:
+    def render_trace(self, qs: Optional[dict] = None) -> dict:
         """Chrome-trace doc: tracer spans + flight-recorder events
-        merged as instant markers (one Perfetto load shows both). The
-        fleet events source serves records without a process timebase,
-        so only the LOCAL recorder merges into /trace — /events is
-        the fleet surface."""
+        merged as instant markers (one Perfetto load shows both), or
+        the injected ``trace_source`` document (the coordinator's
+        offset-corrected fleet merge). ``?since=<seq>``/``?n=`` bound
+        the local span window — mirror of ``/events`` paging — so a
+        cadence puller doesn't reship the whole ring each tick."""
+        if self._trace_source is not None:
+            return self._trace_source()
+        qs = qs or {}
+        first = lambda k: (qs.get(k) or [None])[0]  # noqa: E731
+        since = last_n = None
+        try:
+            if first("since") is not None:
+                since = int(first("since"))
+            if first("n") is not None:
+                last_n = int(first("n"))
+        except ValueError:
+            pass  # malformed paging params: serve the full window
         from edl_tpu.obs import events as _events
 
-        return _events.default_recorder().to_chrome_doc(self.tracer)
+        return _events.default_recorder().to_chrome_doc(
+            self.tracer, since_seq=since or 0, last_n=last_n
+        )
 
     def render_events(self, qs: Optional[dict] = None) -> str:
         """JSONL of the events source, filtered by ``rid``/``kind``/
@@ -222,12 +247,12 @@ class MetricsExporter:
 
 def start_exporter(
     source=None, *, port: int = 0, host: str = "127.0.0.1", tracer=None,
-    events_source=None,
+    events_source=None, trace_source=None,
 ) -> MetricsExporter:
     """Convenience: construct + start (``port=0`` = ephemeral)."""
     return MetricsExporter(
         source, port=port, host=host, tracer=tracer,
-        events_source=events_source,
+        events_source=events_source, trace_source=trace_source,
     ).start()
 
 

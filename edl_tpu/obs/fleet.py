@@ -32,6 +32,8 @@ log = kv_logger("obs")
 
 METRICS_KV_PREFIX = "metrics"  # {job}/metrics/{worker} holds snapshot JSON
 EVENTS_KV_PREFIX = "events"  # {job}/events/{worker} holds a JSONL window
+TRACE_KV_PREFIX = "trace"  # {job}/trace/{worker} holds a span window
+CLOCK_KV_PREFIX = "clock"  # {job}/clock/{worker} holds a ClockEstimate
 
 
 def metrics_key(job: str, worker: str) -> str:
@@ -40,6 +42,14 @@ def metrics_key(job: str, worker: str) -> str:
 
 def events_key(job: str, worker: str) -> str:
     return f"{job}/{EVENTS_KV_PREFIX}/{worker}"
+
+
+def trace_key(job: str, worker: str) -> str:
+    return f"{job}/{TRACE_KV_PREFIX}/{worker}"
+
+
+def clock_key(job: str, worker: str) -> str:
+    return f"{job}/{CLOCK_KV_PREFIX}/{worker}"
 
 
 class MetricsPusher:
@@ -69,6 +79,10 @@ class MetricsPusher:
         events_publish: Optional[Callable[[str], None]] = None,
         events_window: int = 256,
         recorder=None,
+        trace_publish: Optional[Callable[[str], None]] = None,
+        trace_window: int = 128,
+        tracer=None,
+        clock_refresh: Optional[Callable[[], None]] = None,
     ):
         self._publish = publish
         self.interval_s = max(float(interval_s), 0.1)
@@ -80,6 +94,16 @@ class MetricsPusher:
         self._events_publish = events_publish
         self.events_window = events_window
         self._recorder = recorder
+        # recent tracer-span window on the same cadence: what the
+        # coordinator's fleet /trace merges onto one clock axis
+        # (obs/disttrace.span_window_json — wall-anchored spans)
+        self._trace_publish = trace_publish
+        self.trace_window = trace_window
+        self._tracer = tracer
+        # throttled clock re-sample (disttrace.ClockSync.maybe_sample
+        # closure): offsets drift, so the estimate refreshes on the
+        # push cadence without a dedicated thread
+        self._clock_refresh = clock_refresh
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # push_once runs on the pusher thread AND from stop()'s
@@ -109,6 +133,14 @@ class MetricsPusher:
                     rec = _events.default_recorder()
                 # single-line doc: coordinator KV is a line protocol
                 self._events_publish(rec.window_json(self.events_window))
+            if self._trace_publish is not None:
+                from edl_tpu.obs import disttrace
+
+                self._trace_publish(
+                    disttrace.span_window_json(self._tracer, self.trace_window)
+                )
+            if self._clock_refresh is not None:
+                self._clock_refresh()
             with self._state_lock:
                 self.pushes += 1
                 self._failing = False
@@ -177,12 +209,17 @@ def aggregate_snapshots(
     return reg
 
 
-def collect_fleet(client, job: str, extra_sources: Iterable[str] = ()) -> MetricsRegistry:
+def collect_fleet(
+    client, job: str, extra_sources: Iterable[str] = (),
+    straggler: bool = True,
+) -> MetricsRegistry:
     """Coordinator-side aggregation pass: read every live member's
     pushed snapshot (plus well-known non-member sources like the
     epoch's dist_service host) from KV and merge. Rebuilt per scrape —
     counters stay correct because each pass starts from an empty
-    registry."""
+    registry. With ``straggler`` (default) the merged per-worker
+    step-time histograms additionally feed the skew gauges
+    (:func:`straggler_pass`)."""
     names = [m.name for m in client.members()]
     names.extend(extra_sources)
     snaps: Dict[str, str] = {}
@@ -193,22 +230,52 @@ def collect_fleet(client, job: str, extra_sources: Iterable[str] = ()) -> Metric
     reg = aggregate_snapshots(snaps)
     g = reg.gauge("edl_fleet_reporting_workers", "workers with a pushed metrics snapshot")
     g.set(len(snaps))
+    if straggler:
+        try:
+            straggler_pass(reg, client=client, job=job)
+        except Exception as e:  # analysis must never kill the scrape
+            log.warn("straggler pass failed", error=str(e))
     return reg
 
 
+def load_clock_offsets(
+    client, job: str, names: Iterable[str]
+) -> Dict[str, float]:
+    """Per-worker clock offsets (seconds to ADD to a worker's wall
+    clock to land on the coordinator axis) from the estimates each
+    worker published at its register/heartbeat handshake
+    (obs/disttrace.ClockSync). Missing/undecodable -> omitted (treated
+    as 0 downstream)."""
+    from edl_tpu.obs.disttrace import ClockEstimate
+
+    out: Dict[str, float] = {}
+    for name in names:
+        raw = client.kv_get(clock_key(job, name))
+        if not raw:
+            continue
+        est = ClockEstimate.from_json(raw)
+        if est is not None:
+            out[name] = est.offset_s
+    return out
+
+
 def collect_fleet_events(
-    client, job: str, extra_sources: Iterable[str] = ()
+    client, job: str, extra_sources: Iterable[str] = (),
+    apply_clock: bool = True,
 ) -> list:
     """Coordinator-side fleet log: read every live member's pushed
     flight-recorder window from KV, tag each record with its worker
-    (unless the worker already stamped its context), and merge in
-    causal order (wall time, then per-process seq). Undecodable
-    windows are skipped like bad metric snapshots — a half-written KV
-    value must not kill the scrape."""
+    (unless the worker already stamped its context), correct each
+    record's ``t_wall`` onto the coordinator's clock axis using the
+    published per-worker offsets (``apply_clock``), and merge in
+    causal order (corrected wall time, then per-process seq).
+    Undecodable windows are skipped like bad metric snapshots — a
+    half-written KV value must not kill the scrape."""
     from edl_tpu.obs.events import load_jsonl
 
     names = [m.name for m in client.members()]
     names.extend(extra_sources)
+    offsets = load_clock_offsets(client, job, names) if apply_clock else {}
     merged: list = []
     for name in names:
         raw = client.kv_get(events_key(job, name))
@@ -218,11 +285,122 @@ def collect_fleet_events(
             recs = load_jsonl(raw)
         except ValueError:
             continue  # a window with no events yet
+        off = offsets.get(name, 0.0)
         for r in recs:
             r.setdefault("corr", {}).setdefault("worker", name)
+            if off and "t_wall" in r:
+                try:
+                    r["t_wall"] = float(r["t_wall"]) + off
+                except (TypeError, ValueError):
+                    pass
         merged.extend(recs)
     merged.sort(key=lambda r: (r.get("t_wall", 0.0), r.get("seq", 0)))
     return merged
+
+
+def collect_fleet_trace(
+    client, job: str, extra_sources: Iterable[str] = (),
+    local_name: str = "coordinator", tracer=None,
+) -> dict:
+    """The fleet ``/trace`` document: every live member's pushed span
+    window ({job}/trace/{worker}), offset-corrected onto the
+    coordinator's clock axis and merged into ONE Perfetto doc with a
+    per-worker ``pid``, ``process_name`` metadata, and chrome flow
+    events linking RPC client→server span pairs
+    (obs/disttrace.merge_fleet_trace). The coordinator process's own
+    tracer rides along as ``local_name`` (offset 0 — it IS the
+    reference clock)."""
+    from edl_tpu.obs import disttrace
+
+    names = [m.name for m in client.members()]
+    names.extend(extra_sources)
+    windows: Dict[str, str] = {}
+    for name in names:
+        raw = client.kv_get(trace_key(job, name))
+        if raw:
+            windows[name] = raw
+    offsets = load_clock_offsets(client, job, names)
+    if local_name:
+        windows[local_name] = disttrace.span_window_doc(tracer)
+        offsets[local_name] = 0.0
+    return disttrace.merge_fleet_trace(windows, offsets)
+
+
+# ---------------------------------------------------------------------------
+# straggler analysis (obs/disttrace primitives -> scrapeable gauges)
+
+
+# emit straggler.detected once per (worker, rounded skew) — a scrape
+# cadence must not flood the flight ring with identical detections
+_last_straggler: Optional[tuple] = None
+_straggler_lock = threading.Lock()
+
+
+def straggler_pass(
+    reg: MetricsRegistry,
+    client=None,
+    job: Optional[str] = None,
+    threshold: Optional[float] = None,
+) -> None:
+    """Derive straggler telemetry from a fleet-merged registry (and,
+    when a KV client is given, the fleet event log):
+
+    * ``edl_step_skew_ratio`` — slowest worker's step p50 over the
+      fleet median (1.0 = even; needs >= 2 reporting workers);
+    * ``edl_barrier_wait_seconds{worker}`` — rendezvous-barrier wait
+      attributed to the LAST arriver (from offset-corrected
+      ``worker.join`` arrivals of the latest epoch);
+    * a ``straggler.detected`` flight event naming the slow worker
+      when the skew crosses ``threshold`` (EDL_STRAGGLER_RATIO,
+      default 1.5)."""
+    import os as _os
+
+    from edl_tpu.obs import disttrace
+
+    if threshold is None:
+        try:
+            threshold = float(_os.environ.get("EDL_STRAGGLER_RATIO", "1.5"))
+        except ValueError:
+            threshold = 1.5
+    fam = reg.get("edl_train_step_seconds")
+    p50s: Dict[str, float] = {}
+    if fam is not None and "worker" in fam.labelnames:
+        wi = list(fam.labelnames).index("worker")
+        for key, _s in fam.samples():
+            w = key[wi]
+            p50s[w] = fam.percentile(
+                0.5, **dict(zip(fam.labelnames, key))
+            )
+    skew, slow, median = disttrace.step_skew(p50s)
+    reg.gauge(
+        "edl_step_skew_ratio",
+        "slowest worker step p50 over the fleet median (1.0 = even)",
+    ).set(skew)
+    if slow is not None and skew >= threshold:
+        global _last_straggler
+        sig = (slow, round(skew, 1))
+        with _straggler_lock:
+            fire, _last_straggler = sig != _last_straggler, sig
+        if fire:
+            from edl_tpu.obs import events as _events
+
+            _events.emit(
+                "straggler.detected", severity="warn", worker=slow,
+                skew_ratio=round(skew, 3), fleet_median_s=round(median, 6),
+                p50_s=round(p50s[slow], 6),
+            )
+    if client is not None and job is not None:
+        waits = disttrace.barrier_waits_from_events(
+            collect_fleet_events(client, job)
+        )
+        if waits:
+            g = reg.gauge(
+                "edl_barrier_wait_seconds",
+                "rendezvous-barrier wait charged to the last arriver",
+                ("worker",),
+            )
+            for w, wait in sorted(waits.items()):
+                g.set(wait, worker=w)
 
 
 # ---------------------------------------------------------------------------

@@ -283,8 +283,13 @@ def _fmt_gap(dt: float) -> str:
 
 
 def _fmt_event(e: Dict[str, Any], t_base: float, prev_t: float) -> str:
+    from edl_tpu.obs.disttrace import without_ids
+
+    # trace ids correlate /trace with /events but are noise in a human
+    # timeline (use `edl trace` for the span view of the same ids)
     corr = {
-        k: v for k, v in (e.get("corr") or {}).items() if k != "rid"
+        k: v for k, v in without_ids(e.get("corr") or {}).items()
+        if k != "rid"
     }
     attrs = e.get("attrs") or {}
     kv = " ".join(

@@ -133,7 +133,11 @@ def summarize(fams: _Fams) -> List[str]:
             for ph in sorted(set(mfu_by_phase) | set(bw_by_phase))
             if mfu_by_phase.get(ph) or bw_by_phase.get(ph)
         ]
-        lines.append("EFFICNCY " + "  ".join(parts))
+        # 8-char label like every other strip (the misspelled
+        # "EFFICNCY" header shipped in PR 8; "ROOFLINE" names the same
+        # surface — doc/observability.md "Hardware efficiency &
+        # roofline" — and keeps the 9-column data alignment)
+        lines.append("ROOFLINE " + "  ".join(parts))
         if hbm:
             gb = lambda v: f"{v / (1 << 30):.2f}G"  # noqa: E731
             occ = _total(fams, "edl_kv_occupancy_ratio")

@@ -27,9 +27,10 @@ import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
+from edl_tpu.obs import disttrace
 from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.runtime.data import ElasticDataQueue, Task
-from edl_tpu.utils import faults
+from edl_tpu.utils import faults, tracing
 from edl_tpu.utils.logging import kv_logger
 
 log = kv_logger("coordinator")
@@ -261,6 +262,10 @@ class NativeCoordinator:
         self._lib.edl_members(self._h, buf, len(buf))
         return _parse_members(buf.value.decode())
 
+    def time(self) -> float:
+        """In-process: the reference clock IS this process's clock."""
+        return time.time()
+
     # barriers
     def barrier_arrive(self, name: str, worker: str) -> int:
         return self._lib.edl_barrier_arrive(self._h, name.encode(), worker.encode())
@@ -390,7 +395,18 @@ class CoordinatorClient:
                     # here, driving the REAL close/reconnect/backoff
                     # path below (scripts/exp_chaos.py soaks this at 5%)
                     faults.fault_point("coord.rpc")
-                    out = self._roundtrip(line)
+                    if disttrace.current() is not None:
+                        # on a traced path (a step/reshard/request
+                        # root is active) the round trip becomes a
+                        # client span carrying the trace context —
+                        # the fleet merge's flow-link anchor. Untraced
+                        # polling loops cost one contextvar read.
+                        with tracing.span(
+                            "coord.rpc", op=line.split(" ", 1)[0]
+                        ):
+                            out = self._roundtrip(line)
+                    else:
+                        out = self._roundtrip(line)
                     rpcs.inc(op=line.split(" ", 1)[0])
                     return out
                 except (ConnectionError, OSError, socket.timeout) as e:
@@ -407,6 +423,17 @@ class CoordinatorClient:
 
     def ping(self) -> bool:
         return self._call("PING") == "PONG"
+
+    def time(self) -> Optional[float]:
+        """The coordinator's wall clock (epoch seconds) — one round
+        trip of the clock-alignment handshake (obs/disttrace.py
+        ClockSync brackets this call with local reads). None against
+        an old server binary without the TIME op, so callers degrade
+        to offset 0 instead of failing bring-up."""
+        r = self._call("TIME")
+        if not r.startswith("TIME "):
+            return None
+        return int(r.split()[1]) / 1e6
 
     def kv_put(self, k: str, v: str) -> None:
         self._call(f"PUT {k} {v}")
@@ -637,6 +664,11 @@ class PyCoordinator:
                     sorted(self._members.items())
                 )
             ]
+
+    def time(self):
+        """Duck-typed clock-sync parity: in-process fallback, so the
+        reference clock is the local one."""
+        return time.time()
 
     def barrier_arrive(self, name, worker):
         with self._lock:

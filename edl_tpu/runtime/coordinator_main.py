@@ -81,13 +81,20 @@ def main(argv=None) -> int:
         port=a.metrics_port,
         # /events here is the worker-labeled FLEET log: the union of
         # every member's pushed flight-recorder window ({job}/events/*)
+        # on ONE clock axis (per-worker offsets applied)
         events_source=lambda: obs.collect_fleet_events(
+            client, a.job, EXTRA_METRIC_SOURCES
+        ),
+        # /trace here is the FLEET merge: every member's pushed span
+        # window, offset-corrected, worker-labeled, with RPC flow
+        # links (obs/fleet.collect_fleet_trace -> disttrace)
+        trace_source=lambda: obs.collect_fleet_trace(
             client, a.job, EXTRA_METRIC_SOURCES
         ),
     )
     print(
         f"coordinator on :{a.port}; fleet metrics at {exporter.url}/metrics "
-        f"(fleet event log at /events)",
+        f"(fleet event log at /events, merged fleet trace at /trace)",
         flush=True,
     )
     try:

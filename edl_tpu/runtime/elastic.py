@@ -42,6 +42,7 @@ from edl_tpu.train.trainer import (
     shard_state,
 )
 from edl_tpu.obs import costmodel as _costmodel
+from edl_tpu.obs import disttrace
 from edl_tpu.obs import events as flight
 from edl_tpu.obs import memledger
 from edl_tpu.obs import metrics as obs_metrics
@@ -344,15 +345,25 @@ class ElasticTrainer:
             return
         prev = self.n_workers
         step_at = self._host_step
-        used_fallback = False
         # reshard_epoch: this trainer's reshard ordinal — the flight-
-        # recorder correlation key tying begin/end/recompile together
+        # recorder correlation key tying begin/end/recompile together.
+        # The whole rescale runs under a DERIVED trace root
+        # ("reshard", ep): every reshard-phase span and event shares
+        # trace id disttrace.derived_trace_id("reshard", ep), which is
+        # how `edl trace --reshard-epoch N` selects the chain without
+        # any id exchange.
         ep = len(self.report.reshards)
         log.info("reshard begin", from_workers=prev, to_workers=target)
+        with disttrace.root("reshard", ep):
+            self._rescale_traced(target, prev, step_at, ep)
+
+    def _rescale_traced(self, target, prev, step_at, ep) -> None:
+        used_fallback = False
         flight.emit("reshard.begin", reshard_epoch=ep, step=step_at,
                     from_workers=prev, to_workers=target)
         with Timer() as stall, tracing.span(
-            "reshard", from_workers=prev, to_workers=target, step=step_at
+            "reshard", from_workers=prev, to_workers=target, step=step_at,
+            reshard_epoch=ep,
         ):
             # delayed-sync groups are collapsed to their average before
             # the move: the new dp width means a new group count, and the

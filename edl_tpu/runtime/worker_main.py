@@ -59,8 +59,10 @@ from typing import Any, Callable, Dict, Optional
 
 import numpy as np
 
+from edl_tpu.obs import disttrace
 from edl_tpu.runtime.coordinator import CoordinatorClient
 from edl_tpu.runtime import entrypoint
+from edl_tpu.utils import tracing
 from edl_tpu.utils.logging import kv_logger
 
 log = kv_logger("worker")
@@ -253,6 +255,23 @@ class ElasticWorker:
         # every flight-recorder event this process emits from here on
         # carries worker identity — the fleet log's correlation key
         obs.events.default_recorder().set_context(worker=cfg.worker_id)
+        # clock alignment (obs/disttrace): bracket coordinator TIME
+        # round trips to estimate this process's wall-clock offset
+        # (NTP midpoint, min-RTT sample) and publish it so the fleet
+        # merge lands every worker's spans/events on ONE axis. Refresh
+        # rides the metrics-push cadence below, throttled.
+        self._clock = obs.disttrace.ClockSync()
+        clock_kv = obs.clock_key(cfg.job, cfg.worker_id)
+
+        def _clock_publish():
+            try:
+                est = self._clock.maybe_sample(self.client.time)
+                if est is not None:
+                    self.client.kv_put(clock_kv, est.to_json())
+            except Exception as e:  # telemetry must never take the job
+                log.warn("clock sync failed", error=str(e))
+
+        _clock_publish()
         if cfg.metrics_port >= 0:
             try:
                 self._exporter = obs.start_exporter(port=cfg.metrics_port)
@@ -267,17 +286,24 @@ class ElasticWorker:
         if cfg.metrics_push_s > 0:
             key = obs.metrics_key(cfg.job, cfg.worker_id)
             ekey = obs.events_key(cfg.job, cfg.worker_id)
+            tkey = obs.trace_key(cfg.job, cfg.worker_id)
             # the main client is lock-serialized per roundtrip, so the
             # pusher thread can share it (same pattern would hold for a
             # dedicated connection; sharing avoids a third socket).
-            # The flight-recorder window rides the same cadence so the
-            # coordinator's /events shows the worker-labeled fleet log.
+            # The flight-recorder window AND the recent tracer-span
+            # window ride the same cadence so the coordinator's
+            # /events shows the worker-labeled fleet log and /trace
+            # merges every worker onto the coordinator's clock axis.
             self._pusher = obs.MetricsPusher(
                 lambda payload: self.client.kv_put(key, payload),
                 interval_s=cfg.metrics_push_s,
                 events_publish=lambda payload: self.client.kv_put(
                     ekey, payload
                 ),
+                trace_publish=lambda payload: self.client.kv_put(
+                    tkey, payload
+                ),
+                clock_refresh=_clock_publish,
             ).start()
 
     def _telemetry_stop(self) -> None:
@@ -1156,11 +1182,64 @@ class ElasticWorker:
         first_loss_key = self._k("loss_first")
         while True:
             i = int(jax.device_get(state.step))
+            # one DERIVED trace per lockstep decision: every process
+            # independently opens trace ("step", job, epoch, i) — no
+            # id exchange needed — so rank 0's publish span and each
+            # follower's recv span land in one trace. The recv span
+            # parents to the publish span through the go key's trace
+            # side key, which is the cross-process client→server pair
+            # the fleet merge links with a flow arrow.
             if rank == 0:
+                step_tok = disttrace.enter_root("step", cfg.job, epoch, i)
                 verb = self._decide(cl, epoch, i)
-                cl.kv_put(go_key, f"{i}:{verb}")
+                with tracing.span("coord.go", step=i, verb=verb):
+                    # ctx side key FIRST: a follower that can read the
+                    # verb must already be able to fetch its context
+                    disttrace.publish_ctx(cl.kv_put, go_key, tag=str(i))
+                    cl.kv_put(go_key, f"{i}:{verb}")
             else:
+                # the await poll runs OUTSIDE the trace root: polling
+                # RPCs must not flood the span ring while rank 0 is
+                # inside a long step
                 verb = self._await_go(cl, go_key, i, members)
+                step_tok = disttrace.enter_root("step", cfg.job, epoch, i)
+                rctx = disttrace.fetch_ctx(cl.kv_get, go_key, tag=str(i))
+                if rctx is not None:
+                    tracing.tracer().record(
+                        "coord.go.recv", time.perf_counter(), 0.0,
+                        {"step": i, "verb": verb,
+                         **disttrace.link_attrs(rctx)},
+                    )
+            try:
+                verb = self._step_verb(
+                    cfg, jax, cl, epoch, rank, world, members, state,
+                    step, stepper, verb, i, go_key, first_loss_key,
+                    sharding, batch_fn, h_step, h_data, h_block,
+                    c_examples, g_loss, eff, n_local,
+                )
+            finally:
+                disttrace.exit_root(step_tok)
+            if isinstance(verb, tuple):  # (new state, keep looping)
+                state = verb[0]
+                continue
+            return verb
+
+    def _step_verb(
+        self, cfg, jax, cl, epoch, rank, world, members, state, step,
+        stepper, verb, i, go_key, first_loss_key, sharding, batch_fn,
+        h_step, h_data, h_block, c_examples, g_loss, eff, n_local,
+    ):
+        """One published verb's work, inside the step's trace root.
+        Returns ``(new_state,)`` to continue the lockstep loop or the
+        epoch outcome string ("stop" | "reshard"). The whole verb runs
+        under a ``train.step`` span so the fleet trace shows each
+        worker's step duration beside the go decision that caused it
+        (per-worker step skew is visible on one axis)."""
+        from edl_tpu.runtime import checkpoint as ckpt
+
+        with tracing.span(
+            "train.step", step=i, verb=verb, worker=self.cfg.worker_id
+        ):
             if verb in ("step", "ckpt"):
                 t_iter = time.perf_counter()
                 local, task_id = self._local_batch(cl, batch_fn)
@@ -1244,6 +1323,7 @@ class ElasticWorker:
                     rank, members,
                 )
                 return verb
+        return (state,)
 
     def _await_peer_reaped(self, cl, failed_epoch: int) -> None:
         """A collective just failed, so some peer is dead but may not

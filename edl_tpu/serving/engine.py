@@ -102,6 +102,7 @@ records into goodput-under-SLO.
 
 from __future__ import annotations
 
+import contextlib
 import time
 import weakref
 from collections import OrderedDict, deque
@@ -124,6 +125,7 @@ from edl_tpu.serving.scheduler import (
     Request,
     RequestQueue,
 )
+from edl_tpu.obs import disttrace
 from edl_tpu.obs import events as flight
 from edl_tpu.utils import faults, tracing
 from edl_tpu.utils.logging import kv_logger
@@ -596,8 +598,14 @@ class ContinuousBatchingEngine:
         # span measures the ENQUEUE cost only (the dispatch is async);
         # the device-side block time shows up as serving.drain on the
         # block that finally syncs it — together they are the
-        # dispatch/block breakdown the obs bridge exposes
-        with tracing.span("serving.dispatch", horizon=self.horizon):
+        # dispatch/block breakdown the obs bridge exposes. ``rids``
+        # lists the slots riding this block, so /trace filters on the
+        # same correlation key as /events?rid= (block spans are shared
+        # across requests; per-request identity is the attr, not the
+        # span).
+        rids = [s.rid for s in self._slots if s is not None]
+        with tracing.span("serving.dispatch", horizon=self.horizon,
+                          rids=rids):
             (toks, self._dtok, self._dpos, self._dact, self._drem,
              self._kc, self._vc) = self._decode(
                 self.params, old[0], old[1], old[2], old[3], self._deos,
@@ -623,7 +631,10 @@ class ContinuousBatchingEngine:
         read -1 and terminate the row's replay — the device freezes a
         row at exactly the step the host would finish it, so the two
         views never disagree."""
-        with tracing.span("serving.drain"):
+        with tracing.span(
+            "serving.drain",
+            rids=[s.rid for s in self._slots if s is not None],
+        ):
             blk, t_dispatch = self._inflight.popleft()
             # chaos site: the popped block is lost on a crash here —
             # its tokens exist only on device, recovery must regenerate
@@ -792,7 +803,15 @@ class ContinuousBatchingEngine:
         prefill = _prefill_program(self.cfg, tb, self._sampling)
         old = (self._dtok, self._dpos, self._dact, self._drem,
                self._deos, self._kc, self._vc)
-        with tracing.span("serving.prefill", bucket=tb):
+        # request trace root, DERIVED from the rid: the prefill span
+        # and the serve.prefill event share trace id
+        # derived_trace_id("rid", rid) without any id exchange, so a
+        # fleet trace and the event log agree on the request's identity
+        rid_root = (
+            disttrace.root("rid", rid) if rid is not None
+            else contextlib.nullcontext()
+        )
+        with rid_root, tracing.span("serving.prefill", bucket=tb, rid=rid):
             (tok0, self._dtok, self._dpos, self._dact, self._drem,
              self._deos, self._kc, self._vc) = prefill(
                 self.params,

@@ -37,6 +37,22 @@ class Span:
     dur_s: float
     attrs: Dict[str, Any] = field(default_factory=dict)
     thread: int = 0
+    seq: int = 0  # per-tracer monotonic id (survives ring eviction)
+
+
+# distributed-trace context hooks (installed by edl_tpu.obs.disttrace
+# at import): ``enter()`` runs at span open and returns (state, attrs)
+# — the attrs carry the span's trace/span/parent ids when a trace is
+# active — and ``exit(state)`` restores the enclosing context. Kept as
+# injected callables so this low-level module stays free of obs
+# imports and the hook costs one None-check when tracing alone.
+_ctx_enter = None
+_ctx_exit = None
+
+
+def set_span_context_hooks(enter, exit) -> None:
+    global _ctx_enter, _ctx_exit
+    _ctx_enter, _ctx_exit = enter, exit
 
 
 class Tracer:
@@ -56,8 +72,13 @@ class Tracer:
     def __init__(self, max_spans: int = 100_000):
         self._lock = threading.Lock()
         self._spans: Deque[Span] = deque(maxlen=max_spans)
+        # adjacent reads: t0_wall anchors start_s (perf_counter-
+        # relative) on the wall clock, which is what lets span windows
+        # from different processes merge onto one axis (obs/disttrace)
         self._t0 = time.perf_counter()
+        self.t0_wall = time.time()
         self.t0 = self._t0  # public timebase (flight-recorder merge)
+        self._seq = 0  # monotonic span id; never reset (paging cursor)
         self.max_spans = max_spans
         self.enabled = True
         self.dropped = 0  # spans evicted after the ring filled
@@ -68,10 +89,20 @@ class Tracer:
         if not self.enabled:
             yield
             return
+        state = ctx_attrs = None
+        if _ctx_enter is not None:
+            # the span body runs inside its OWN child trace context:
+            # nested spans parent here, and flight events emitted
+            # within carry these ids (how /trace and /events agree)
+            state, ctx_attrs = _ctx_enter()
         start = time.perf_counter()
         try:
             yield
         finally:
+            if _ctx_exit is not None:
+                _ctx_exit(state)
+            if ctx_attrs:
+                attrs = {**attrs, **ctx_attrs}
             self.record(name, start, time.perf_counter() - start, attrs)
 
     def record(self, name: str, start_s: float, dur_s: float,
@@ -83,6 +114,8 @@ class Tracer:
         span = Span(name, start_s - self._t0, dur_s, dict(attrs or {}),
                     threading.get_ident())
         with self._lock:
+            self._seq += 1
+            span.seq = self._seq
             if len(self._spans) >= self.max_spans:
                 # ring semantics: evict the OLDEST, keep the new span
                 if self.dropped == 0:
@@ -159,12 +192,24 @@ class Tracer:
         with self._lock:
             return list(self._spans), self.dropped
 
-    def to_chrome_doc(self) -> Dict[str, Any]:
+    def to_chrome_doc(
+        self, since_seq: int = 0, last_n: Optional[int] = None
+    ) -> Dict[str, Any]:
         """Full chrome-trace JSON document: the events plus a metadata
         ("M") event and top-level ``dropped``, so a viewer AND a raw
         reader both see ring-buffer truncation. Served by the obs
-        exporter's ``/trace`` and written by :meth:`dump`."""
+        exporter's ``/trace`` and written by :meth:`dump`.
+
+        ``since_seq``/``last_n`` bound the window (the ``/events``
+        paging mirror): only spans with ``seq > since_seq`` ship,
+        newest ``last_n`` kept. The metadata event carries ``max_seq``
+        so an incremental puller knows its next cursor — a fleet
+        cadence tick fetches the delta, not the whole ring."""
         spans, dropped = self._snapshot()
+        if since_seq:
+            spans = [s for s in spans if s.seq > since_seq]
+        if last_n is not None:
+            spans = spans[-max(int(last_n), 0):]
         events = [
             {
                 "name": s.name,
@@ -173,6 +218,7 @@ class Tracer:
                 "dur": s.dur_s * 1e6,
                 "pid": os.getpid(),
                 "tid": s.thread % 2**31,
+                "seq": s.seq,
                 "args": s.attrs,
             }
             for s in spans
@@ -187,6 +233,7 @@ class Tracer:
                     "dropped": dropped,
                     "max_spans": self.max_spans,
                     "spans": len(events),
+                    "max_seq": max((s.seq for s in spans), default=since_seq),
                 },
             }
         )

@@ -21,12 +21,14 @@
 //   QDONE                       -> DONE 0|1
 //   QSTATS                      -> STATS todo leased done dead epoch
 //   PING                        -> PONG
+//   TIME                        -> TIME <epoch_micros>   (clock sync)
 
 #include <arpa/inet.h>
 #include <netinet/in.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <chrono>
 #include <csignal>
 #include <cstdio>
 #include <cstring>
@@ -51,6 +53,13 @@ std::string Handle(const std::string& line) {
     return rest;
   };
   if (cmd == "PING") return "PONG";
+  if (cmd == "TIME") {
+    // the fleet's reference wall clock: workers bracket this round
+    // trip to estimate their offset (NTP midpoint, obs/disttrace.py)
+    auto now = std::chrono::system_clock::now().time_since_epoch();
+    auto us = std::chrono::duration_cast<std::chrono::microseconds>(now);
+    return "TIME " + std::to_string(us.count());
+  }
   if (cmd == "PUT") {
     std::string k;
     in >> k;

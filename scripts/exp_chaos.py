@@ -389,6 +389,211 @@ def training_lane(seed, n_leases, tmp_root):
 
 
 # ---------------------------------------------------------------------------
+# fleet distributed-tracing lane: two REAL OS processes with injected
+# clock skew, merged onto one axis (ISSUE 9 acceptance)
+
+
+_HELPER_SRC = '''
+"""Second fleet process for the chaos fleet-trace lane: registers as
+w1 with a wall clock running +SKEW seconds ahead, completes the
+client->server go pair, and pushes a slow-worker telemetry set
+(span window, skewed worker.join, 10x step histogram, clock offset).
+jax-free."""
+import sys, time
+
+sys.path.insert(0, sys.argv[3])
+from edl_tpu.obs import disttrace as dt
+from edl_tpu.obs import events as flight
+from edl_tpu.obs import fleet
+from edl_tpu.obs import metrics as om
+from edl_tpu.runtime.coordinator import CoordinatorClient
+from edl_tpu.utils import tracing
+
+SKEW = 5.0
+port, job = int(sys.argv[1]), sys.argv[2]
+c = CoordinatorClient("127.0.0.1", port)
+c.register("w1", 1)
+# this process's "wall clock" runs SKEW ahead: shift the tracer anchor
+# and the recorder clock the way a genuinely skewed host would
+tr = tracing.Tracer()
+tr.t0_wall += SKEW
+# the register-handshake clock sync measures the REAL offset; the
+# fabricated skew adds to it, exactly what correction must undo
+est = dt.ClockSync().sample(c.time, n=5)
+base_off = est.offset_s if est else 0.0
+rtt = est.rtt_s if est else 0.0
+c.kv_put(fleet.clock_key(job, "w1"),
+         dt.ClockEstimate(base_off - SKEW, rtt, 5).to_json())
+# server half of the go pair: parent a recv span to the published ctx
+rctx, deadline = None, time.time() + 15
+while rctx is None and time.time() < deadline:
+    rctx = dt.fetch_ctx(c.kv_get, job + "/go", tag="fleet")
+    time.sleep(0.01)
+assert rctx is not None, "no published go context"
+tr.record("coord.go.recv", time.perf_counter(), 0.0,
+          {"step": 0, **dt.link_attrs(rctx)})
+with tr.span("train.step", step=0, worker="w1"):
+    time.sleep(0.05)
+# the slow worker: step p50 10x the harness's
+reg = om.MetricsRegistry()
+h = reg.histogram("edl_train_step_seconds", "steps")
+for _ in range(32):
+    h.observe(0.5)
+c.kv_put(fleet.metrics_key(job, "w1"), reg.snapshot_json())
+rec = flight.FlightRecorder(clock=lambda: time.time() + SKEW)
+rec.emit("worker.join", worker="w1", epoch=1)
+c.kv_put(fleet.events_key(job, "w1"), rec.window_json())
+c.kv_put(fleet.trace_key(job, "w1"), dt.span_window_json(tr, 64))
+c.kv_put(job + "/helper_done", "1")
+c.close()
+'''
+
+
+def fleet_trace_lane(tmp_root, events_dir=None):
+    """Merged fleet trace across two real processes (ISSUE 9):
+
+    * the harness (as ``w0``) publishes a rank-0-style ``go`` decision
+      with its trace context on the KV side key; a REAL second process
+      (``w1``) — whose wall clock is fabricated to run +5 s ahead —
+      parents its recv span to it;
+    * both push span windows + clock estimates; the merged ``/trace``
+      doc must show both processes on ONE offset-corrected axis with
+      exactly one client→server flow link (skew uncorrected would put
+      the recv ~5 s after the publish);
+    * the straggler pass over the merged metrics must flag ``w1``
+      (step p50 10x the fleet median) and charge the barrier wait to
+      the last arriver;
+    * the reshard of the earlier training lane and a served rid of the
+      serving lane must both yield non-empty critical paths — the doc
+      is dumped for the `edl trace --assert-critical-path` CI phase.
+    """
+    import subprocess
+
+    from edl_tpu.obs import disttrace as dt
+    from edl_tpu.obs import events as flight
+    from edl_tpu.obs import fleet as obs_fleet
+    from edl_tpu.obs import metrics as obs_metrics
+    from edl_tpu.runtime import coordinator as coord_mod
+    from edl_tpu.utils import tracing
+
+    if not coord_mod.ensure_native_built():
+        print("\n== fleet trace lane SKIPPED: no native coordinator "
+              "toolchain ==")
+        return
+    job = "fleet"
+    print("\n== fleet trace lane: 2 processes, +5s injected skew ==")
+    srv = coord_mod.CoordinatorServer(member_ttl_s=30.0)
+    helper = None
+    try:
+        client = coord_mod.CoordinatorClient("127.0.0.1", srv.port)
+        client.register("w0", 1)
+        # our own clock estimate (the reference is the coordinator
+        # server on this host, so the offset is ~0 — published anyway,
+        # the honest handshake)
+        est = dt.ClockSync().sample(client.time, n=5)
+        if est is not None:
+            client.kv_put(obs_fleet.clock_key(job, "w0"), est.to_json())
+        # w0 arrives at the epoch barrier FIRST (the helper joins ~a
+        # second later), so the merge must charge w0 the wait
+        rec = flight.FlightRecorder()
+        rec.emit("worker.join", worker="w0", epoch=1)
+        client.kv_put(obs_fleet.events_key(job, "w0"), rec.window_json())
+        helper_path = os.path.join(tmp_root, "fleet_helper.py")
+        with open(helper_path, "w") as f:
+            f.write(_HELPER_SRC)
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        helper = subprocess.Popen(
+            [sys.executable, helper_path, str(srv.port), job, repo]
+        )
+        # rank-0 style go publish: ctx side key first, then the verb
+        with dt.root("step", job, 0, 0):
+            with tracing.span("coord.go", step=0, verb="step"):
+                dt.publish_ctx(client.kv_put, job + "/go", tag="fleet")
+                client.kv_put(job + "/go", "0:step")
+            time.sleep(0.02)
+        deadline = time.time() + 30
+        while client.kv_get(job + "/helper_done") is None:
+            assert time.time() < deadline, "fleet helper never finished"
+            assert helper.poll() is None, "fleet helper died"
+            time.sleep(0.05)
+        helper.wait(timeout=10)
+        # w0's telemetry set: fast steps, first barrier arrival, and
+        # the process tracer window (holds the earlier lanes' serving
+        # + reshard spans — the critical-path material)
+        reg = obs_metrics.MetricsRegistry()
+        h = reg.histogram("edl_train_step_seconds", "steps")
+        for _ in range(32):
+            h.observe(0.05)
+        client.kv_put(obs_fleet.metrics_key(job, "w0"), reg.snapshot_json())
+        client.kv_put(
+            obs_fleet.trace_key(job, "w0"),
+            dt.span_window_json(tracing.tracer(), 2048),
+        )
+
+        doc = obs_fleet.collect_fleet_trace(client, job, local_name="")
+        assert sorted(doc["workers"]) == ["w0", "w1"], doc["workers"]
+        assert doc["flow_links"] == 1, (
+            f"want exactly 1 client->server flow link, got "
+            f"{doc['flow_links']}"
+        )
+        xs = {e["name"]: e for e in doc["traceEvents"] if e.get("ph") == "X"
+              if e["args"].get("worker") in ("w0", "w1")}
+        go = next(e for e in doc["traceEvents"] if e.get("ph") == "X"
+                  and e["name"] == "coord.go")
+        recv = next(e for e in doc["traceEvents"] if e.get("ph") == "X"
+                    and e["name"] == "coord.go.recv")
+        assert go["args"]["worker"] == "w0" and recv["args"]["worker"] == "w1"
+        lag_s = (recv["ts"] - go["ts"]) / 1e6
+        # offset correction must have eaten the +5 s fabricated skew:
+        # the recv follows the publish by transport+poll time, not 5 s
+        assert 0.0 <= lag_s < 2.5, (
+            f"offset correction failed: recv lags publish by {lag_s:.3f}s"
+        )
+
+        # straggler pass over the merged fleet metrics
+        merged = obs_fleet.collect_fleet(client, job)
+        skew_ratio = merged.get("edl_step_skew_ratio").value()
+        assert skew_ratio > 1.5, f"step skew not detected: {skew_ratio}"
+        waits = {k[0]: v[0] for k, v in
+                 merged.get("edl_barrier_wait_seconds").samples()}
+        assert waits.get("w0", 0.0) > 0.0, (
+            f"barrier wait not charged to the early arrival: {waits}"
+        )
+        det = flight.default_recorder().events(kind="straggler.detected")
+        assert det and det[-1].corr["worker"] == "w1", (
+            "straggler.detected missing or misattributed"
+        )
+
+        # critical paths: the training lane's reshard and a served rid
+        hops = dt.critical_path(doc, reshard_epoch=0)
+        assert hops, "empty critical path for reshard epoch 0"
+        rid = next(
+            (e["args"]["rid"] for e in doc["traceEvents"]
+             if e.get("ph") == "X" and e.get("args", {}).get("rid")),
+            None,
+        )
+        assert rid is not None, "no rid-carrying span in the fleet trace"
+        rid_hops = dt.critical_path(doc, rid=rid)
+        assert rid_hops, f"empty critical path for served rid {rid}"
+        print(f"fleet trace OK: workers={doc['workers']} "
+              f"flow_links={doc['flow_links']} recv_lag={lag_s * 1e3:.1f}ms "
+              f"skew_ratio={skew_ratio:.2f} barrier_wait_w0={waits['w0']:.2f}s "
+              f"reshard_hops={len(hops)} rid={rid} rid_hops={len(rid_hops)}")
+        if events_dir:
+            with open(os.path.join(events_dir, "fleet_trace.json"), "w") as f:
+                import json
+
+                json.dump(doc, f)
+            with open(os.path.join(events_dir, "fleet_trace.rid"), "w") as f:
+                f.write(rid)
+        client.close()
+    finally:
+        if helper is not None and helper.poll() is None:
+            helper.kill()
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
 # pusher backoff micro-check (jax-free, runs even without the native
 # coordinator)
 
@@ -454,6 +659,7 @@ def main():
 
     with tempfile.TemporaryDirectory(prefix="edl-chaos-") as tmp:
         training_lane(args.seed, n_leases, tmp)
+        fleet_trace_lane(tmp, events_dir=args.events_dir)
     print(f"\nchaos soak OK in {time.perf_counter() - t0:.1f}s "
           f"({injected_total():.0f} total faults injected)")
 

@@ -94,7 +94,7 @@ for f in "$EVDIR"/chaos-*.jsonl; do
   python -m edl_tpu.cli postmortem "$f" --assert-recovered > /dev/null \
     || { echo "postmortem FAILED for $f"; rc6=1; }
 done
-rm -rf "$EVDIR"
+# EVDIR kept: phase 9 verifies the fleet trace dump from the same run
 t6=$(date +%s)
 echo "== phase 6 done in $((t6 - t5))s (rc=$rc6) =="
 
@@ -148,6 +148,31 @@ JAX_PLATFORMS=cpu python -m edl_tpu.cli profile --dryrun --metrics-port 0 \
 python scripts/perf_gate.py || rc8=1
 t8=$(date +%s)
 echo "== phase 8 done in $((t8 - t7))s (rc=$rc8) =="
-echo "== total $((t8 - t0))s =="
 
-[ "$rc0" -eq 0 ] && [ "$rc1" -eq 0 ] && [ "$rc2" -eq 0 ] && [ "$rc3" -eq 0 ] && [ "$rc4" -eq 0 ] && [ "$rc5" -eq 0 ] && [ "$rc6" -eq 0 ] && [ "$rc7" -eq 0 ] && [ "$rc8" -eq 0 ]
+echo "== phase 9: fleet trace critical path (edl trace over the chaos merge) =="
+# the distributed-tracing contract, verified from OUTSIDE the harness:
+# the chaos run's merged fleet trace (2 real processes, +5s injected
+# clock skew corrected away, exactly one RPC flow link) must yield a
+# non-empty critical path for the grow reshard AND for a served rid —
+# a fleet trace that cannot answer "where did the time go" fails CI.
+rc9=0
+if [ -e "$EVDIR/fleet_trace.json" ]; then
+  python -m edl_tpu.cli trace "$EVDIR/fleet_trace.json" \
+      --reshard-epoch 0 --assert-critical-path \
+      || { echo "edl trace FAILED for reshard epoch 0"; rc9=1; }
+  RID=$(cat "$EVDIR/fleet_trace.rid")
+  python -m edl_tpu.cli trace "$EVDIR/fleet_trace.json" \
+      --rid "$RID" --assert-critical-path \
+      || { echo "edl trace FAILED for rid $RID"; rc9=1; }
+else
+  # the chaos lane skips the fleet trace without the native toolchain;
+  # fail only if phase 5 itself claimed success with events enabled
+  echo "no fleet trace dump in $EVDIR (native coordinator missing?)"
+  [ "$rc5" -eq 0 ] && [ -e "$EVDIR/faultfree.jsonl" ] || rc9=1
+fi
+rm -rf "$EVDIR"
+t9=$(date +%s)
+echo "== phase 9 done in $((t9 - t8))s (rc=$rc9) =="
+echo "== total $((t9 - t0))s =="
+
+[ "$rc0" -eq 0 ] && [ "$rc1" -eq 0 ] && [ "$rc2" -eq 0 ] && [ "$rc3" -eq 0 ] && [ "$rc4" -eq 0 ] && [ "$rc5" -eq 0 ] && [ "$rc6" -eq 0 ] && [ "$rc7" -eq 0 ] && [ "$rc8" -eq 0 ] && [ "$rc9" -eq 0 ]

@@ -399,6 +399,62 @@ def test_telemetry_conflicting_registration(tmp_path):
     assert any("conflicting schema" in f.message for f in rep.findings)
 
 
+def test_telemetry_trace_keys_only_via_disttrace(tmp_path):
+    """Hand-rolled trace-context key access (subscript, .get, dict
+    literal) is flagged everywhere EXCEPT obs/disttrace.py — the
+    helpers own the wire format."""
+    rep = run_on(tmp_path, """
+    def relay(corr, remote):
+        corr["trace_id"] = remote.trace_id          # subscript write
+        parent = corr.get("parent_id")              # dict-method read
+        return {"span_id": parent}                  # dict literal
+    """, rules=["telemetry-conventions"])
+    msgs = [f.message for f in rep.findings]
+    assert len(msgs) == 3, msgs
+    assert all("obs/disttrace helpers" in m for m in msgs)
+    assert any("'trace_id' (subscript)" in m for m in msgs)
+    assert any("'parent_id' (.get())" in m for m in msgs)
+    assert any("'span_id' (dict literal)" in m for m in msgs)
+
+
+def test_telemetry_trace_keys_clean_patterns(tmp_path):
+    """The sanctioned shapes stay clean: disttrace.py itself, helper
+    calls, and attribute access (ctx.trace_id is not a dict key)."""
+    home = run_on(tmp_path, """
+    def inject(d, ctx):
+        d["trace_id"] = ctx.trace_id
+        return d.get("span_id")
+    """, rules=["telemetry-conventions"], name="disttrace.py",
+        extra={"obs/__init__.py": ""})
+    # fixture file is named disttrace.py but not under obs/ — still
+    # flagged; the real home path is exempt
+    assert len(home.findings) == 2
+    ok = run_on(tmp_path, """
+    from edl_tpu.obs import disttrace
+
+    def relay(corr):
+        ctx = disttrace.extract(corr)
+        tid = ctx.trace_id if ctx else None
+        return disttrace.inject({}, ctx), tid
+    """, rules=["telemetry-conventions"])
+    assert ok.findings == []
+
+
+def test_telemetry_trace_keys_exempt_in_disttrace_home(tmp_path):
+    p = tmp_path / "obs"
+    p.mkdir()
+    (p / "disttrace.py").write_text(
+        'def inject(d, c):\n    d["trace_id"] = c.trace_id\n    return d\n'
+    )
+    import edl_tpu.analysis as analysis_mod
+
+    rep = analysis_mod.run_check(
+        [str(p / "disttrace.py")],
+        rules=["telemetry-conventions"], root=str(tmp_path),
+    )
+    assert rep.findings == []
+
+
 def test_telemetry_fault_site_coverage(tmp_path):
     covered = run_on(tmp_path, """
     from edl_tpu.utils import faults

@@ -1,0 +1,537 @@
+"""Distributed tracing (edl_tpu/obs/disttrace.py) — context
+propagation through spans/events/KV, the NTP-midpoint clock sync, the
+offset-corrected fleet trace merge (adversarial: injected ±5 s skew,
+torn windows, exactly-one flow link per client/server pair), the
+critical-path extraction, straggler telemetry, /trace paging, and the
+`edl trace` CLI verb. jax-free throughout."""
+
+import json
+
+import pytest
+
+from edl_tpu import obs
+from edl_tpu.obs import disttrace as dt
+from edl_tpu.obs import events as flight
+from edl_tpu.obs import fleet
+from edl_tpu.obs import metrics as om
+from edl_tpu.runtime.coordinator import PyCoordinator
+from edl_tpu.utils import tracing
+
+
+@pytest.fixture
+def fresh_obs():
+    reg = om.reset_default_registry()
+    rec = flight.reset_default_recorder()
+    yield reg, rec
+    om.reset_default_registry()
+    flight.reset_default_recorder()
+
+
+# ---------------------------------------------------------------------------
+# ids + context stack
+
+
+def test_derived_trace_ids_are_deterministic_and_distinct():
+    a = dt.derived_trace_id("step", "job", 0, 7)
+    assert a == dt.derived_trace_id("step", "job", 0, 7)
+    assert a != dt.derived_trace_id("step", "job", 0, 8)
+    assert a != dt.derived_trace_id("reshard", 7)
+    assert dt.new_id() != dt.new_id()
+
+
+def test_root_and_child_context_nesting():
+    assert dt.current() is None
+    with dt.root("rid", "r1") as ctx:
+        assert ctx.trace_id == dt.derived_trace_id("rid", "r1")
+        assert ctx.parent_id is None
+        child = ctx.child()
+        assert child.trace_id == ctx.trace_id
+        assert child.parent_id == ctx.span_id
+    assert dt.current() is None
+
+
+def test_spans_carry_and_nest_trace_context():
+    tr = tracing.Tracer()
+    with dt.root("reshard", 3):
+        with tr.span("outer"):
+            with tr.span("inner"):
+                pass
+        with tr.span("sibling"):
+            pass
+    outer, inner, sibling = (
+        {s.name: s for s in tr.spans()}[n]
+        for n in ("outer", "inner", "sibling")
+    )
+    o_t, o_s, o_p = dt.ids_of(outer.attrs)
+    i_t, _i_s, i_p = dt.ids_of(inner.attrs)
+    s_t, _s_s, s_p = dt.ids_of(sibling.attrs)
+    assert o_t == i_t == s_t == dt.derived_trace_id("reshard", 3)
+    assert i_p == o_s  # nested span parents to the enclosing one
+    assert s_p == o_p  # siblings share the root parent, not each other
+    # outside a root, spans stay id-free (zero noise when untraced)
+    with tr.span("untraced"):
+        pass
+    assert dt.ids_of({s.name: s for s in tr.spans()}["untraced"].attrs) == (
+        None, None, None,
+    )
+
+
+def test_events_stamp_active_context(fresh_obs):
+    _reg, rec = fresh_obs
+    with dt.root("rid", "r9"):
+        with tracing.tracer().span("serving.prefill", rid="r9"):
+            flight.emit("serve.prefill", rid="r9")
+    ev = rec.events(kind="serve.prefill")[-1]
+    tid, sid, _ = dt.ids_of(ev.corr)
+    assert tid == dt.derived_trace_id("rid", "r9")
+    assert sid is not None
+    # span + event agree on the trace — /trace and /events?rid= key
+    sp = [s for s in tracing.tracer().spans("serving.prefill")][-1]
+    assert dt.ids_of(sp.attrs)[0] == tid
+
+
+def test_inject_extract_roundtrip_and_kv_side_key():
+    with dt.root("step", "j", 0, 1) as ctx:
+        d = dt.inject({})
+        assert dt.extract(d) == dt.current()
+        kv = {}
+        dt.publish_ctx(kv.__setitem__, "j/go/0", tag="1")
+        got = dt.fetch_ctx(kv.get, "j/go/0", tag="1")
+        assert got is not None and got.trace_id == ctx.trace_id
+        # a stale tag (previous step's leftover) is rejected
+        assert dt.fetch_ctx(kv.get, "j/go/0", tag="2") is None
+    assert dt.extract({}) is None
+    # a raising kv_get degrades to None, never to the caller
+    def boom(_k):
+        raise ConnectionError("gone")
+    assert dt.fetch_ctx(boom, "j/go/0", tag="1") is None
+
+
+# ---------------------------------------------------------------------------
+# clock sync
+
+
+def test_clock_sync_midpoint_recovers_injected_skew():
+    t = {"now": 100.0}
+    local = lambda: t["now"]  # noqa: E731
+
+    def remote():
+        # symmetric 10 ms legs; remote clock runs 5 s AHEAD
+        t["now"] += 0.01
+        ts = t["now"] + 5.0
+        t["now"] += 0.01
+        return ts
+
+    est = dt.ClockSync(clock=local).sample(remote, n=4)
+    assert est is not None and est.n == 4
+    assert est.offset_s == pytest.approx(5.0, abs=1e-9)
+    assert est.rtt_s == pytest.approx(0.02, abs=1e-9)
+
+
+def test_clock_sync_prefers_minimum_rtt_sample():
+    t = {"now": 0.0, "i": 0}
+    # sample 1: 2 s asymmetric round trip (bad midpoint); sample 2:
+    # tight 2 ms round trip (good) — the jitter filter must pick #2
+    legs = [(2.0, 0.0), (0.001, 0.001)]
+
+    def remote():
+        a, b = legs[t["i"]]
+        t["i"] += 1
+        t["now"] += a
+        ts = t["now"] + 5.0
+        t["now"] += b
+        return ts
+
+    est = dt.ClockSync(clock=lambda: t["now"]).sample(remote, n=2)
+    assert est.rtt_s == pytest.approx(0.002, abs=1e-9)
+    assert est.offset_s == pytest.approx(5.0, abs=1e-3)
+
+
+def test_clock_sync_unsupported_and_failing_remote():
+    assert dt.ClockSync().sample(lambda: None, n=3) is None
+
+    def broken():
+        raise ConnectionError("no TIME op")
+
+    assert dt.ClockSync().sample(broken, n=3) is None
+    est = dt.ClockEstimate.from_json('{"offset_s": 1.5, "rtt_s": 0.01}')
+    assert est.offset_s == 1.5
+    assert dt.ClockEstimate.from_json("torn{") is None
+
+
+def test_pycoordinator_time_supports_handshake():
+    c = PyCoordinator()
+    est = dt.ClockSync().sample(c.time, n=3)
+    assert est is not None
+    assert abs(est.offset_s) < 1.0  # same process, same clock
+
+
+# ---------------------------------------------------------------------------
+# span windows + fleet merge (adversarial)
+
+
+def _window(name_times, skew=0.0, trace=None, extra_args=None):
+    """Fabricate a worker's span window: [(name, t_wall, dur), ...]
+    with ``skew`` seconds added to its clock."""
+    spans = []
+    for i, (name, t, dur) in enumerate(name_times):
+        args = dict(extra_args or {})
+        if trace:
+            args = dt.inject(args, trace[i])
+        spans.append(
+            {"name": name, "seq": i + 1, "t_wall": t + skew,
+             "dur_s": dur, "tid": 1, "args": args}
+        )
+    return json.dumps({"meta": {"pid": 1}, "spans": spans})
+
+
+def test_span_window_roundtrip_and_torn_tolerance():
+    tr = tracing.Tracer()
+    with tr.span("a", x=1):
+        pass
+    doc = dt.load_span_window(dt.span_window_json(tr))
+    assert [s["name"] for s in doc["spans"]] == ["a"]
+    assert doc["spans"][0]["args"]["x"] == 1
+    assert doc["spans"][0]["t_wall"] == pytest.approx(
+        tr.t0_wall + tr.spans()[0].start_s
+    )
+    # torn JSON -> None; partial records -> skipped, not fatal
+    assert dt.load_span_window('{"spans": [{"name": "a"') is None
+    part = dt.load_span_window(
+        '{"spans": [{"name": "ok", "t_wall": 1.0},'
+        ' {"dur_s": 0.5}, "junk", {"name": "no_time"}]}'
+    )
+    assert [s["name"] for s in part["spans"]] == ["ok"]
+
+
+def test_merge_restores_ordering_under_5s_skew():
+    # true causality: w0's span ends BEFORE w1's starts (0.1 s later),
+    # but w1's wall clock runs 5 s ahead — raw timestamps would put
+    # w1 5 s late... and with NEGATIVE skew, before w0 even started.
+    for skew in (+5.0, -5.0):
+        w0 = _window([("go", 1000.0, 0.05)])
+        w1 = _window([("recv", 1000.1, 0.05)], skew=skew)
+        doc = dt.merge_fleet_trace(
+            {"w0": w0, "w1": w1}, offsets={"w1": -skew}
+        )
+        xs = {e["name"]: e for e in doc["traceEvents"] if e.get("ph") == "X"}
+        assert xs["recv"]["ts"] - xs["go"]["ts"] == pytest.approx(
+            0.1 * 1e6, abs=1.0
+        )
+        # worker identity survives: one pid per process, named
+        names = {
+            e["pid"]: e["args"]["name"]
+            for e in doc["traceEvents"]
+            if e.get("ph") == "M" and e.get("name") == "process_name"
+        }
+        assert sorted(names.values()) == ["w0", "w1"]
+        assert xs["go"]["pid"] != xs["recv"]["pid"]
+
+
+def test_merge_links_exactly_one_client_server_pair():
+    client = dt.TraceContext("t" * 16, "c" * 16, None)
+    server = dt.TraceContext("t" * 16, "s" * 16, "c" * 16)
+    bystander = dt.TraceContext("t" * 16, "b" * 16, "missing-parent")
+    w0 = _window([("coord.go", 10.0, 0.01)], trace=[client])
+    w1 = _window(
+        [("coord.go.recv", 10.02, 0.001), ("other", 10.5, 0.01)],
+        trace=[server, bystander],
+    )
+    doc = dt.merge_fleet_trace({"w0": w0, "w1": w1})
+    flows = [e for e in doc["traceEvents"] if e.get("ph") in ("s", "f")]
+    assert doc["flow_links"] == 1
+    assert len(flows) == 2  # one start + one finish, same id
+    s, f = (
+        next(e for e in flows if e["ph"] == "s"),
+        next(e for e in flows if e["ph"] == "f"),
+    )
+    assert s["id"] == f["id"]
+    xs = {e["name"]: e for e in doc["traceEvents"] if e.get("ph") == "X"}
+    assert s["pid"] == xs["coord.go"]["pid"]
+    assert f["pid"] == xs["coord.go.recv"]["pid"]
+
+
+def test_merge_skips_undecodable_windows():
+    doc = dt.merge_fleet_trace(
+        {"ok": _window([("a", 1.0, 0.1)]), "bad": "not json {"}
+    )
+    assert doc["workers"] == ["ok"]
+    assert doc["skipped_windows"] == 1
+
+
+def test_intra_process_parent_child_gets_no_flow_arrow():
+    parent = dt.TraceContext("t" * 16, "p" * 16, None)
+    child = dt.TraceContext("t" * 16, "q" * 16, "p" * 16)
+    w = _window(
+        [("outer", 1.0, 0.2), ("inner", 1.05, 0.1)], trace=[parent, child]
+    )
+    doc = dt.merge_fleet_trace({"w0": w})
+    assert doc["flow_links"] == 0
+
+
+# ---------------------------------------------------------------------------
+# critical path
+
+
+def test_critical_path_follows_longest_linked_chain():
+    root = dt.TraceContext("t" * 16, "r" * 16, None)
+    a = dt.TraceContext("t" * 16, "a" * 16, "r" * 16)   # 0.1 s branch
+    b = dt.TraceContext("t" * 16, "b" * 16, "r" * 16)   # 0.5 s branch
+    b2 = dt.TraceContext("t" * 16, "e" * 16, "b" * 16)  # extends b
+    w0 = _window([("root", 0.0, 0.05)], trace=[root])
+    w1 = _window(
+        [("short", 0.06, 0.1), ("long", 0.06, 0.5), ("tail", 0.6, 0.2)],
+        trace=[a, b, b2],
+    )
+    doc = dt.merge_fleet_trace({"w0": w0, "w1": w1})
+    hops = dt.critical_path(doc, trace_id="t" * 16)
+    assert [h["name"] for h in hops] == ["root", "long", "tail"]
+    assert hops[1]["gap_s"] == pytest.approx(0.01, abs=1e-6)
+    assert hops[0]["worker"] == "w0" and hops[1]["worker"] == "w1"
+
+
+def test_critical_path_rid_matches_block_rids_and_time_orders():
+    w = _window(
+        [
+            ("serving.prefill", 1.0, 0.02),
+            ("serving.dispatch", 1.05, 0.001),
+            ("serving.drain", 1.10, 0.01),
+            ("serving.prefill", 2.0, 0.02),  # another request
+        ],
+    )
+    doc = json.loads(w)
+    doc["spans"][0]["args"]["rid"] = "r1"
+    doc["spans"][1]["args"]["rids"] = ["r1", "r2"]
+    doc["spans"][2]["args"]["rids"] = ["r1"]
+    doc["spans"][3]["args"]["rid"] = "r2"
+    merged = dt.merge_fleet_trace({"w0": json.dumps(doc)})
+    hops = dt.critical_path(merged, rid="r1")
+    assert [h["name"] for h in hops] == [
+        "serving.prefill", "serving.dispatch", "serving.drain",
+    ]
+    assert dt.critical_path(merged, rid="zzz") == []
+    assert "3 hops" in dt.render_critical_path(hops)
+
+
+def test_critical_path_selects_derived_reshard_root():
+    tr = tracing.Tracer()
+    with dt.root("reshard", 2):
+        with tr.span("reshard", reshard_epoch=2):
+            with tr.span("reshard.device_transfer"):
+                pass
+    doc = dt.merge_fleet_trace({"w0": dt.span_window_doc(tr)})
+    hops = dt.critical_path(doc, reshard_epoch=2)
+    assert [h["name"] for h in hops] == ["reshard", "reshard.device_transfer"]
+    assert dt.critical_path(doc, reshard_epoch=3) == []
+
+
+# ---------------------------------------------------------------------------
+# straggler primitives + fleet pass
+
+
+def test_step_skew_and_barrier_waits_math():
+    skew, slow, median = dt.step_skew({"w0": 0.1, "w1": 0.1, "w2": 0.3})
+    assert slow == "w2"
+    assert skew == pytest.approx(3.0)
+    assert median == pytest.approx(0.1)
+    assert dt.step_skew({"w0": 0.1}) == (0.0, None, 0.0)
+    waits = dt.barrier_waits({"w0": 10.0, "w1": 10.4, "w2": 9.8})
+    assert waits["w1"] == pytest.approx(0.0)  # last arriver waits 0
+    assert waits["w2"] == pytest.approx(0.6)
+
+
+def test_barrier_waits_from_fleet_events_latest_epoch():
+    evs = [
+        {"kind": "worker.join", "t_wall": 1.0,
+         "corr": {"worker": "w0"}, "attrs": {"epoch": 1}},
+        {"kind": "worker.join", "t_wall": 1.3,
+         "corr": {"worker": "w1"}, "attrs": {"epoch": 1}},
+        {"kind": "worker.join", "t_wall": 5.0,
+         "corr": {"worker": "w0"}, "attrs": {"epoch": 2}},
+        {"kind": "worker.join", "t_wall": 5.9,
+         "corr": {"worker": "w1"}, "attrs": {"epoch": 2}},
+        {"kind": "worker.hb", "t_wall": 9.0,
+         "corr": {"worker": "w0"}, "attrs": {}},
+    ]
+    waits = dt.barrier_waits_from_events(evs)
+    assert waits == {"w0": pytest.approx(0.9), "w1": pytest.approx(0.0)}
+    assert dt.barrier_waits_from_events([]) == {}
+
+
+def _push_worker_state(c, job, worker, step_s, n=40, join_t=None,
+                       clock_off=None):
+    reg = om.MetricsRegistry()
+    h = reg.histogram("edl_train_step_seconds", "steps")
+    for _ in range(n):
+        h.observe(step_s)
+    c.kv_put(fleet.metrics_key(job, worker), reg.snapshot_json())
+    if join_t is not None:
+        rec = flight.FlightRecorder(clock=lambda: join_t)
+        rec.emit("worker.join", worker=worker, epoch=1)
+        c.kv_put(fleet.events_key(job, worker), rec.window_json())
+    if clock_off is not None:
+        c.kv_put(
+            fleet.clock_key(job, worker),
+            dt.ClockEstimate(clock_off, 0.001, 3).to_json(),
+        )
+
+
+def test_collect_fleet_straggler_gauges_and_event(fresh_obs):
+    _reg, rec = fresh_obs
+    fleet._last_straggler = None  # reset the emit dedup
+    c = PyCoordinator()
+    c.register("w0", 1)
+    c.register("w1", 1)
+    _push_worker_state(c, "j", "w0", 0.01, join_t=100.0)
+    _push_worker_state(c, "j", "w1", 0.10, join_t=102.5)
+    merged = fleet.collect_fleet(c, "j")
+    skew = merged.get("edl_step_skew_ratio").value()
+    assert skew > 1.5
+    waits = dict(
+        (k[0], v[0]) for k, v in
+        merged.get("edl_barrier_wait_seconds").samples()
+    )
+    assert waits["w0"] == pytest.approx(2.5)
+    assert waits["w1"] == pytest.approx(0.0)
+    det = rec.events(kind="straggler.detected")
+    assert len(det) == 1 and det[0].corr["worker"] == "w1"
+    # a second scrape with the same skew does not re-emit
+    fleet.collect_fleet(c, "j")
+    assert len(rec.events(kind="straggler.detected")) == 1
+
+
+def test_fleet_events_apply_clock_offsets(fresh_obs):
+    c = PyCoordinator()
+    c.register("w0", 1)
+    c.register("w1", 1)
+    # w1's clock runs 5 s ahead; its event at TRUE time 100.2 reads
+    # 105.2 — without correction it sorts after everything
+    _push_worker_state(c, "j", "w0", 0.01, join_t=100.4)
+    _push_worker_state(c, "j", "w1", 0.01, join_t=105.2, clock_off=-5.0)
+    evs = [e for e in fleet.collect_fleet_events(c, "j")
+           if e["kind"] == "worker.join"]
+    assert [e["corr"]["worker"] for e in evs] == ["w1", "w0"]
+    assert evs[0]["t_wall"] == pytest.approx(100.2)
+    raw = [e for e in fleet.collect_fleet_events(c, "j", apply_clock=False)
+           if e["kind"] == "worker.join"]
+    assert [e["corr"]["worker"] for e in raw] == ["w0", "w1"]
+
+
+def test_collect_fleet_trace_end_to_end(fresh_obs):
+    c = PyCoordinator()
+    c.register("w0", 1)
+    c.kv_put(fleet.trace_key("j", "w0"), _window([("train.step", 50.0, 0.2)]))
+    c.kv_put(
+        fleet.clock_key("j", "w0"),
+        dt.ClockEstimate(2.0, 0.001, 3).to_json(),
+    )
+    doc = fleet.collect_fleet_trace(c, "j")
+    assert set(doc["workers"]) == {"coordinator", "w0"}
+    xs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert any(e["args"].get("worker") == "w0" for e in xs)
+
+
+# ---------------------------------------------------------------------------
+# pusher + exporter surfaces
+
+
+def test_pusher_publishes_trace_window_and_refreshes_clock(fresh_obs):
+    tr = tracing.Tracer()
+    with tr.span("a"):
+        pass
+    got = {}
+    ticks = {"clock": 0}
+
+    def clock_refresh():
+        ticks["clock"] += 1
+
+    p = obs.MetricsPusher(
+        lambda payload: got.__setitem__("m", payload),
+        interval_s=10.0,
+        trace_publish=lambda payload: got.__setitem__("t", payload),
+        tracer=tr,
+        clock_refresh=clock_refresh,
+    )
+    assert p.push_once()
+    doc = dt.load_span_window(got["t"])
+    assert [s["name"] for s in doc["spans"]] == ["a"]
+    assert ticks["clock"] == 1
+    assert "\n" not in got["t"]  # KV line protocol
+
+
+def test_exporter_trace_paging(fresh_obs):
+    tr = tracing.Tracer()
+    for i in range(5):
+        with tr.span(f"s{i}"):
+            pass
+    exp = obs.MetricsExporter(om.MetricsRegistry(), tracer=tr).start()
+    try:
+        full = json.loads(obs.scrape(exp.url, "/trace"))
+        xs = [e for e in full["traceEvents"] if e.get("ph") == "X"]
+        assert len(xs) == 5
+        meta = next(
+            e for e in full["traceEvents"]
+            if e.get("ph") == "M" and e["name"] == "edl_tracer"
+        )
+        assert meta["args"]["max_seq"] == 5
+        page = json.loads(obs.scrape(exp.url, "/trace?since=3"))
+        names = [e["name"] for e in page["traceEvents"] if e.get("ph") == "X"]
+        assert names == ["s3", "s4"]  # seq 4, 5
+        capped = json.loads(obs.scrape(exp.url, "/trace?n=2"))
+        names = [e["name"] for e in capped["traceEvents"] if e.get("ph") == "X"]
+        assert names == ["s3", "s4"]
+        empty = json.loads(obs.scrape(exp.url, "/trace?since=5"))
+        assert not [e for e in empty["traceEvents"] if e.get("ph") == "X"]
+        # the cursor survives an empty page (puller can resume)
+        meta = next(
+            e for e in empty["traceEvents"]
+            if e.get("ph") == "M" and e["name"] == "edl_tracer"
+        )
+        assert meta["args"]["max_seq"] == 5
+    finally:
+        exp.stop()
+
+
+def test_exporter_fleet_trace_source(fresh_obs):
+    doc = {"traceEvents": [], "workers": ["w0"], "flow_links": 0}
+    exp = obs.MetricsExporter(
+        om.MetricsRegistry(), trace_source=lambda: doc
+    ).start()
+    try:
+        got = json.loads(obs.scrape(exp.url, "/trace"))
+        assert got["workers"] == ["w0"]
+    finally:
+        exp.stop()
+
+
+# ---------------------------------------------------------------------------
+# `edl trace` CLI
+
+
+def test_cli_trace_critical_path_and_assert(tmp_path, capsys):
+    from edl_tpu.cli.main import main as cli_main
+
+    tr = tracing.Tracer()
+    with dt.root("reshard", 0):
+        with tr.span("reshard", reshard_epoch=0):
+            with tr.span("reshard.build_mesh"):
+                pass
+    doc = dt.merge_fleet_trace({"w0": dt.span_window_doc(tr)})
+    p = tmp_path / "trace.json"
+    p.write_text(json.dumps(doc))
+    assert cli_main(
+        ["trace", str(p), "--reshard-epoch", "0", "--assert-critical-path"]
+    ) == 0
+    out = capsys.readouterr().out
+    assert "critical path" in out and "reshard.build_mesh" in out
+    assert cli_main(
+        ["trace", str(p), "--rid", "absent", "--assert-critical-path"]
+    ) == 1
+    capsys.readouterr()  # drain
+    assert cli_main(["trace", str(p), "--reshard-epoch", "0", "--json"]) == 0
+    out = capsys.readouterr().out
+    payload = json.loads(out.splitlines()[-1])
+    assert [h["name"] for h in payload["hops"]] == [
+        "reshard", "reshard.build_mesh",
+    ]
+    assert cli_main(["trace", str(tmp_path / "missing.json")]) == 2

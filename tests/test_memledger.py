@@ -165,7 +165,11 @@ def test_top_renders_efficiency_strip():
     led.set_kv_usage("e", 61, 100)
     fams = om.parse_prometheus_text(reg.render())
     text = "\n".join(summarize(fams))
-    assert "EFFICNCY" in text
+    # the strip header: correctly spelled, 8 chars wide so the data
+    # column lines up with TRAIN/SERVING/RESHARD (the PR 8 "EFFICNCY"
+    # typo is pinned gone)
+    assert "ROOFLINE " in text
+    assert "EFFICNCY" not in text
     assert "decode: mfu=50.0%" in text
     assert "kv=3.00G" in text
     assert "kv_used=61.0%" in text
