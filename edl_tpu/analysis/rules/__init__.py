@@ -1,8 +1,9 @@
-"""The five project rules. Importing this package registers them all
+"""The six project rules. Importing this package registers them all
 (each module calls ``core.register`` at import)."""
 
 from edl_tpu.analysis.rules import (  # noqa: F401
     donation,
+    kvblock,
     lockset,
     recompile,
     silentfail,

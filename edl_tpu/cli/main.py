@@ -830,6 +830,18 @@ def run_serve(args) -> int:
         print(f"--max-recoveries must be >= 0, got {args.max_recoveries}",
               file=sys.stderr)
         return 1
+    if args.block_size < 0:
+        print(f"--block-size must be >= 0, got {args.block_size}",
+              file=sys.stderr)
+        return 1
+    if args.block_size and args.max_len % args.block_size != 0:
+        print(f"--max-len {args.max_len} must be a multiple of "
+              f"--block-size {args.block_size}", file=sys.stderr)
+        return 1
+    if (args.prefix_cache or args.prefill_chunk) and not args.block_size:
+        print("--prefix-cache/--prefill-chunk require --block-size > 0",
+              file=sys.stderr)
+        return 1
     try:
         requests = _read_serve_requests(
             args.requests, args.max_new,
@@ -874,6 +886,10 @@ def run_serve(args) -> int:
         temperature=args.temperature,
         seed=args.seed,
         max_recoveries=args.max_recoveries,
+        block_size=args.block_size,
+        pool_blocks=args.pool_blocks or None,
+        prefix_cache=args.prefix_cache,
+        prefill_chunk=args.prefill_chunk,
     )
     collector = Collector(ServingSource(metrics), out=sys.stderr)
 
@@ -992,6 +1008,14 @@ def run_loadgen(args) -> int:
     if args.ttft_slo <= 0 or args.itl_slo <= 0:
         print("--ttft-slo/--itl-slo must be > 0", file=sys.stderr)
         return 1
+    if not 0.0 <= args.shared_prefix <= 1.0:
+        print(f"--shared-prefix must be in [0, 1], got "
+              f"{args.shared_prefix}", file=sys.stderr)
+        return 1
+    if args.shared_prefix_len < 1:
+        print(f"--shared-prefix-len must be >= 1, got "
+              f"{args.shared_prefix_len}", file=sys.stderr)
+        return 1
     if not (args.dryrun or args.workload_only or args.export_dir):
         print("error: need an EXPORT_DIR, --dryrun, or --workload-only",
               file=sys.stderr)
@@ -1032,6 +1056,8 @@ def run_loadgen(args) -> int:
         burst_factor=args.burst_factor,
         burst_dwell_s=args.burst_dwell_s,
         vocab=cfg.vocab if cfg is not None else args.vocab,
+        shared_prefix_frac=args.shared_prefix,
+        shared_prefix_len=args.shared_prefix_len,
         classes=classes,
     )
     try:
@@ -1641,6 +1667,32 @@ def build_parser() -> argparse.ArgumentParser:
         help="prefill/decode interleave: queue pops admitted between "
         "consecutive batched decode steps",
     )
+    sv.add_argument(
+        "--block-size", type=int, default=0,
+        help="paged KV cache: tokens per KV block (0 = contiguous "
+        "per-slot cache; must divide --max-len). Paging admits on "
+        "free BLOCKS instead of free slots, so short requests pack "
+        "far past the contiguous slot capacity at the same HBM",
+    )
+    sv.add_argument(
+        "--pool-blocks", type=int, default=0,
+        help="paged KV cache: physical blocks in the pool incl. the "
+        "reserved scratch block (0 = max-slots * max-len/block-size "
+        "+ 1, the contiguous-equivalent HBM budget)",
+    )
+    sv.add_argument(
+        "--prefix-cache", action="store_true",
+        help="paged KV cache: share full prompt-prefix blocks between "
+        "requests (refcounted; copy-on-write at divergence) — warm "
+        "repeats of a system prompt skip prefill for the cached blocks",
+    )
+    sv.add_argument(
+        "--prefill-chunk", type=int, default=0,
+        help="paged KV cache: admit long prompts as chunks of at most "
+        "this many tokens, interleaved with decode blocks, bounding "
+        "the TTFT hit running decodes take from a long admission "
+        "(0 = single-dispatch prefill)",
+    )
     sv.add_argument("--temperature", type=float, default=0.0)
     sv.add_argument("--seed", type=int, default=0)
     sv.add_argument(
@@ -1729,6 +1781,17 @@ def build_parser() -> argparse.ArgumentParser:
         "--vocab", type=int, default=512,
         help="token-id space for --dryrun/--workload-only (exports "
         "use the model's)",
+    )
+    lg.add_argument(
+        "--shared-prefix", type=float, default=0.0,
+        help="fraction of requests whose prompt starts with their "
+        "tenant's fixed system-prompt template — the workload shape "
+        "a prefix-cached paged engine (`edl serve --prefix-cache`) "
+        "exists for (0 = off, byte-identical to pre-knob workloads)",
+    )
+    lg.add_argument(
+        "--shared-prefix-len", type=int, default=12,
+        help="tokens in each tenant's shared system-prompt template",
     )
     lg.add_argument(
         "--slots", type=int, default=0,

@@ -759,6 +759,216 @@ def decode_horizon_slots(
     return jnp.swapaxes(outs, 0, 1), tok, pos, active, rem, kc, vc
 
 
+# -- inference: paged KV cache (block tables) --------------------------------
+#
+# The vLLM-PagedAttention memory layout adapted to the donated-buffer,
+# fused-horizon programs above: instead of one contiguous
+# [L, B, max_len, KV, hd] region, K/V live in a POOL of fixed-size
+# blocks [L, n_blocks, block_size, KV, hd] and each slot carries a
+# BLOCK TABLE row mapping its logical positions to physical blocks —
+# logical position p of row r lives at
+# (table[r, p // block_size], p % block_size). The serving engine
+# allocates blocks on demand as each request grows, frees them on
+# finish, and can map LEADING table entries of different rows to the
+# SAME physical block (refcounted shared-prefix reuse) — HBM scales
+# with tokens actually resident, not slots × max_len.
+#
+# Program-stability contract is unchanged: one compiled program per
+# (cfg, shapes); the table is a TRACED int32 operand, so allocation,
+# sharing, and frees are host bookkeeping — membership and mapping
+# changes never retrace. Physical block 0 is reserved by the engine as
+# a SCRATCH block: inactive/frozen lanes and prompt-bucket padding
+# route their writes there, and no live table entry ever maps to it,
+# so colliding scratch writes are never read back.
+
+
+def decode_step_slots_paged(
+    params: Dict,
+    tok: jnp.ndarray,
+    pos: jnp.ndarray,
+    table: jnp.ndarray,
+    kc: jnp.ndarray,
+    vc: jnp.ndarray,
+    cfg: LlamaConfig,
+    block_size: int,
+):
+    """One slot-decode step over the paged pool. tok/pos [B] int32;
+    table [B, M] int32 physical block ids; kc/vc
+    [L, n_blocks, block_size, KV, hd]. Returns (logits [B, V], kc, vc).
+
+    Per-row math is IDENTICAL to :func:`decode_step_slots` — the only
+    differences are the scatter target (the row's CURRENT block at
+    ``pos % block_size`` instead of cache row ``pos``) and the
+    attention read (a table gather reassembles each row's logical
+    [M·bs, KV, hd] view; the ``arange(S) <= pos`` mask hides garbage
+    in covered-but-unwritten and scratch-mapped positions exactly as
+    it hides the contiguous cache's tail). Greedy output is therefore
+    token-identical to the contiguous path whenever the engine's
+    tables cover every written position — the contract
+    tests/test_paged_kv.py pins at H ∈ {1, 4, 16}."""
+    b = tok.shape[0]
+    h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    groups = h // kvh
+    bs = block_size
+    m = table.shape[1]
+    s = m * bs
+    rows = jnp.arange(b)
+    # rows whose pos ran past the table (a frozen lane parked one past
+    # its last token, or a stale lane the host stopped tracking) write
+    # to the scratch block — a clamped gather would alias the LAST real
+    # block and corrupt it
+    inb = pos < s
+    blk = jnp.where(
+        inb, table[rows, jnp.clip(pos // bs, 0, m - 1)], 0
+    )  # [B] physical block per row
+    off = jnp.where(inb, pos % bs, 0)
+    x = jnp.take(params["embed"], tok[:, None], axis=0).astype(cfg.dtype)
+    for i in range(cfg.n_layers):
+        lp = jax.tree_util.tree_map(lambda a: a[i], params["layers"])
+        dt = x.dtype
+        a = _rmsnorm(x, lp["ln1"], cfg.norm_eps)
+        q, knew, vnew = _qkv(cfg, a, lp, pos[:, None])
+        kc = kc.at[i, blk, off].set(knew[:, 0])
+        vc = vc.at[i, blk, off].set(vnew[:, 0])
+        # table gather: [n_blocks, bs, KV, hd][table] -> the row's
+        # logical [B, M, bs, KV, hd] view, flattened to [B, S, KV, hd]
+        kci = kc[i][table].reshape(b, s, kvh, hd)
+        vci = vc[i][table].reshape(b, s, kvh, hd)
+        qg = q.reshape(b, 1, kvh, groups, hd)
+        scores = jnp.einsum("btkgd,bskd->bkgts", qg, kci) / np.sqrt(hd)
+        mask = (jnp.arange(s)[None, :] <= pos[:, None])[:, None, None, None, :]
+        scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dt)
+        o = jnp.einsum("bkgts,bskd->btkgd", probs, vci).reshape(b, 1, h * hd)
+        x = x + _matw(o, lp["wo"])
+        x = _mlp(cfg, x, lp)
+    x = _rmsnorm(x, params["ln_f"], cfg.norm_eps)
+    logits = _matw(x[:, 0], params["lm_head"]).astype(jnp.float32)
+    return logits, kc, vc
+
+
+def decode_horizon_slots_paged(
+    params: Dict,
+    tok: jnp.ndarray,
+    pos: jnp.ndarray,
+    active: jnp.ndarray,
+    rem: jnp.ndarray,
+    eosv: jnp.ndarray,
+    table: jnp.ndarray,
+    kc: jnp.ndarray,
+    vc: jnp.ndarray,
+    cfg: LlamaConfig,
+    block_size: int,
+    horizon: int,
+    key: Optional[jax.Array] = None,
+    temperature=None,
+    sampling: bool = False,
+):
+    """The paged twin of :func:`decode_horizon_slots`: a fused horizon
+    of ``horizon`` :func:`decode_step_slots_paged` steps with the SAME
+    on-device freeze semantics (frozen lanes emit -1, rewrite their
+    frozen position idempotently, and never disturb other rows). The
+    block table is READ-ONLY across the horizon — the engine covers
+    every position the horizon can write before dispatching, so no
+    mid-horizon allocation is ever needed on device."""
+
+    def step(carry, k):
+        tok, pos, active, rem, kc, vc = carry
+        logits, kc, vc = decode_step_slots_paged(
+            params, tok, pos, table, kc, vc, cfg, block_size
+        )
+        if sampling:
+            nxt = jax.random.categorical(k, logits / temperature, axis=-1)
+        else:
+            nxt = jnp.argmax(logits, axis=-1)
+        nxt = jnp.where(active, nxt.astype(jnp.int32), tok)
+        out = jnp.where(active, nxt, -1)
+        pos = jnp.where(active, pos + 1, pos)
+        rem = jnp.where(active, rem - 1, rem)
+        hit = active & (eosv >= 0) & (nxt == eosv)
+        active = active & ~hit & (rem > 0)
+        return (nxt, pos, active, rem, kc, vc), out
+
+    keys = jax.random.split(
+        key if key is not None else jax.random.PRNGKey(0), horizon
+    )
+    (tok, pos, active, rem, kc, vc), outs = jax.lax.scan(
+        step, (tok, pos, active, rem, kc, vc), keys
+    )
+    return jnp.swapaxes(outs, 0, 1), tok, pos, active, rem, kc, vc
+
+
+def prefill_paged(
+    params: Dict,
+    tokens: jnp.ndarray,
+    start,
+    last,
+    table: jnp.ndarray,
+    kc: jnp.ndarray,
+    vc: jnp.ndarray,
+    cfg: LlamaConfig,
+    block_size: int,
+):
+    """Prefill one CHUNK of one slot's prompt into the paged pool.
+
+    ``tokens`` [1, Tb] covers logical positions ``start .. start+Tb-1``
+    with real tokens only through local index ``last`` (end-padding,
+    same bucket contract as :func:`prefill_padded`); positions below
+    ``start`` must already be resident in the pool (earlier chunks, or
+    shared prefix blocks another request prefilled). ``table`` [M] is
+    the ONE slot's block-table row. Returns (logits [1, V] at ``last``,
+    kc, vc).
+
+    This one function serves admission prefill (start = prefix-hit
+    length), CHUNKED prefill of long prompts (each bounded chunk is a
+    separate dispatch, interleaved with decode blocks), and the
+    crash-recovery replay. Queries attend causally to the pool —
+    chunk token t sees every position <= start + t, which includes the
+    chunk's own K/V because the scatter lands before the gather. Pad
+    tokens (t > last) write to the scratch block (never read) and
+    their query rows are discarded by the caller taking ``last``'s
+    logits only."""
+    b, tb = tokens.shape
+    h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    groups = h // kvh
+    bs = block_size
+    m = table.shape[0]
+    s = m * bs
+    positions = start + jnp.arange(tb)  # [Tb] absolute positions
+    tpos = jnp.arange(tb)
+    real = tpos <= last
+    # per-token write targets; pads route to the scratch block so a
+    # bucket overhanging the covered table never writes out of range
+    wblk = jnp.where(
+        real, table[jnp.clip(positions // bs, 0, m - 1)], 0
+    )
+    woff = jnp.where(real, positions % bs, 0)
+    x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
+    qmask = (jnp.arange(s)[None, :] <= positions[:, None])[
+        None, None, None, :, :
+    ]  # [1,1,1,Tb,S]: query t sees pool positions <= start + t
+    for i in range(cfg.n_layers):
+        lp = jax.tree_util.tree_map(lambda a: a[i], params["layers"])
+        dt = x.dtype
+        a = _rmsnorm(x, lp["ln1"], cfg.norm_eps)
+        q, knew, vnew = _qkv(cfg, a, lp, positions)
+        kc = kc.at[i, wblk, woff].set(knew[0])
+        vc = vc.at[i, wblk, woff].set(vnew[0])
+        kci = kc[i][table].reshape(1, s, kvh, hd)
+        vci = vc[i][table].reshape(1, s, kvh, hd)
+        qg = q.reshape(b, tb, kvh, groups, hd)
+        scores = jnp.einsum("btkgd,bskd->bkgts", qg, kci) / np.sqrt(hd)
+        scores = jnp.where(qmask, scores, jnp.finfo(scores.dtype).min)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dt)
+        o = jnp.einsum("bkgts,bskd->btkgd", probs, vci).reshape(b, tb, h * hd)
+        x = x + _matw(o, lp["wo"])
+        x = _mlp(cfg, x, lp)
+    x = _rmsnorm(x, params["ln_f"], cfg.norm_eps)
+    xl = x[jnp.arange(b), last]  # [1, d] — the chunk's last real token
+    logits = _matw(xl, params["lm_head"]).astype(jnp.float32)
+    return logits, kc, vc
+
+
 def generate(
     params: Dict,
     tokens: jnp.ndarray,

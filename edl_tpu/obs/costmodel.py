@@ -223,6 +223,15 @@ def kv_cache_bytes(
     return 2.0 * L * slots * max_len * kv * hd * bytes_per_el
 
 
+def kv_pool_bytes(
+    cfg, n_blocks: int, block_size: int, bytes_per_el: int = 2
+) -> float:
+    """The paged [L, n_blocks, block_size, KV, hd] K + V pool pair
+    (includes the reserved scratch block — it occupies real HBM)."""
+    d, h, kv, hd, ff, L, V = _dims(cfg)
+    return 2.0 * L * n_blocks * block_size * kv * hd * bytes_per_el
+
+
 def decode_step_bytes(
     cfg, param_bytes_total: float, b: int, s_pad: int,
     kv_bytes_per_el: int = 2,

@@ -79,7 +79,20 @@ class MemoryLedger:
         )
         self._g_kv_occ = r.gauge(
             "edl_kv_occupancy_ratio",
-            "used KV-cache tokens over capacity across registered engines",
+            "used KV-cache tokens (contiguous) or allocated blocks "
+            "(paged) over capacity across registered engines",
+        )
+        # owner -> free block count (paged engines only)
+        self._kv_blocks_free: Dict[str, int] = {}
+        self._g_kv_free = r.gauge(
+            "edl_kv_blocks_free",
+            "free KV pool blocks across registered paged engines — the "
+            "headroom admission gates on",
+        )
+        self._c_prefix_hits = r.counter(
+            "edl_kv_prefix_hit_total",
+            "prefix-cache block hits: prompt blocks served from the "
+            "shared KV pool instead of re-prefilled",
         )
 
     # -- allocations --------------------------------------------------------
@@ -140,12 +153,15 @@ class MemoryLedger:
                 released += n
                 touched.add(cat)
             self._kv_usage.pop(owner, None)
+            self._kv_blocks_free.pop(owner, None)
             totals = {c: self._by_category.get(c, 0) for c in touched}
             used = sum(u for u, _ in self._kv_usage.values())
             cap = sum(c for _, c in self._kv_usage.values())
+            free = sum(self._kv_blocks_free.values())
         for c, v in totals.items():
             self._g_bytes.set(v, category=c)
         self._g_kv_occ.set(used / cap if cap else 0.0)
+        self._g_kv_free.set(free)
         return released
 
     # -- KV occupancy -------------------------------------------------------
@@ -159,6 +175,19 @@ class MemoryLedger:
             used = sum(u for u, _ in self._kv_usage.values())
             cap = sum(c for _, c in self._kv_usage.values())
         self._g_kv_occ.set(used / cap if cap else 0.0)
+
+    def set_kv_blocks_free(self, owner: str, free_blocks: int) -> None:
+        """One paged engine's free-block headroom; the gauge aggregates
+        across engines (contiguous engines never call this)."""
+        with self._lock:
+            self._kv_blocks_free[owner] = int(free_blocks)
+            total = sum(self._kv_blocks_free.values())
+        self._g_kv_free.set(total)
+
+    def count_prefix_hits(self, n: int = 1) -> None:
+        """Count ``n`` prompt blocks served from the shared prefix
+        cache (prefill skipped for those positions)."""
+        self._c_prefix_hits.inc(n)
 
     # -- views --------------------------------------------------------------
 

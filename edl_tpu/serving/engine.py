@@ -118,6 +118,7 @@ from edl_tpu.models import llama
 from edl_tpu.obs import compilewatch
 from edl_tpu.obs import costmodel as _cm
 from edl_tpu.obs import memledger
+from edl_tpu.serving import paged as _paged
 from edl_tpu.serving.metrics import ServingMetrics
 from edl_tpu.serving.scheduler import (
     AdmissionError,
@@ -215,6 +216,107 @@ def _prefill_program(cfg: llama.LlamaConfig, tb: int, sampling: bool):
     return _memo(("prefill", cfg, tb, sampling), make)
 
 
+def _block_program_paged(
+    cfg: llama.LlamaConfig, b: int, nb: int, m: int, bs: int,
+    horizon: int, sampling: bool,
+):
+    """The paged twin of :func:`_block_program`: same carries plus the
+    [B, M] block table (read-only, NOT donated — the host rebuilds it
+    from its allocator truth each dispatch); kc/vc are the block POOL
+    [L, nb, bs, KV, hd], donated under the same stale-reference
+    contract."""
+
+    def make():
+        @partial(jax.jit, donate_argnums=(1, 2, 3, 4, 7, 8))
+        def run(params, tok, pos, active, rem, eosv, table, kc, vc,
+                key, temperature):
+            return llama.decode_horizon_slots_paged(
+                params, tok, pos, active, rem, eosv, table, kc, vc, cfg,
+                block_size=bs, horizon=horizon, key=key,
+                temperature=temperature, sampling=sampling,
+            )
+
+        return compilewatch.wrap(run, "serve.block")
+
+    return _memo(("block-paged", cfg, b, nb, m, bs, horizon, sampling), make)
+
+
+def _prefill_paged_program(cfg: llama.LlamaConfig, tb: int, bs: int,
+                           sampling: bool):
+    """Final-piece paged prefill: run the bucketed tail of a prompt
+    (logical positions ``start .. start+last``) through
+    ``llama.prefill_paged``, sample the first token, and reset the
+    slot's device decode state — the paged twin of
+    :func:`_prefill_program`. Earlier positions (prefix-cache hits or
+    previously dispatched chunks) are already resident in the pool."""
+
+    def make():
+        @partial(jax.jit, donate_argnums=(7, 8, 9, 10, 11, 12, 13))
+        def run(params, tokens, start, last, slot, max_new, eos,
+                tok, pos, active, rem, eosv, kc, vc, table,
+                key, temperature):
+            logits, kc, vc = llama.prefill_paged(
+                params, tokens, start, last, table, kc, vc, cfg, bs
+            )
+            if sampling:
+                t0 = jax.random.categorical(key, logits / temperature, axis=-1)
+            else:
+                t0 = jnp.argmax(logits, axis=-1)
+            t0 = t0.astype(jnp.int32)[0]
+            tok = tok.at[slot].set(t0)
+            pos = pos.at[slot].set(start + last + 1)
+            hit = (eos >= 0) & (t0 == eos)
+            active = active.at[slot].set(~hit & (max_new > 1))
+            rem = rem.at[slot].set(jnp.maximum(max_new - 1, 0))
+            eosv = eosv.at[slot].set(eos)
+            return t0, tok, pos, active, rem, eosv, kc, vc
+
+        return compilewatch.wrap(run, "serve.prefill")
+
+    return _memo(("prefill-paged", cfg, tb, bs, sampling), make)
+
+
+def _prefill_chunk_program(cfg: llama.LlamaConfig, c: int, bs: int):
+    """One NON-final prefill chunk: write ``c`` prompt tokens' K/V into
+    the pool at ``start .. start+c-1`` and return only the pools — no
+    logits consumed, no slot state touched, so a long prompt advances
+    one bounded dispatch at a time between decode blocks instead of
+    one monolithic prefill that starves running slots."""
+
+    def make():
+        @partial(jax.jit, donate_argnums=(3, 4))
+        def run(params, tokens, start, kc, vc, table):
+            _, kc, vc = llama.prefill_paged(
+                params, tokens, start, jnp.int32(c - 1), table, kc, vc,
+                cfg, bs,
+            )
+            return kc, vc
+
+        return compilewatch.wrap(run, "serve.prefill")
+
+    return _memo(("prefill-chunk", cfg, c, bs), make)
+
+
+def _copy_block_program(cfg: llama.LlamaConfig, nb: int, bs: int):
+    """Copy one physical KV block (``src`` → ``dst``, traced indices)
+    in both pools — the copy-on-write primitive: a slot about to write
+    into a SHARED block gets a private copy first, so prefix-cache
+    blocks are immutable while referenced."""
+
+    def make():
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def run(kc, vc, src, dst):
+            kb = jax.lax.dynamic_slice_in_dim(kc, src, 1, axis=1)
+            vb = jax.lax.dynamic_slice_in_dim(vc, src, 1, axis=1)
+            kc = jax.lax.dynamic_update_slice_in_dim(kc, kb, dst, axis=1)
+            vc = jax.lax.dynamic_update_slice_in_dim(vc, vb, dst, axis=1)
+            return kc, vc
+
+        return compilewatch.wrap(run, "serve.block_copy")
+
+    return _memo(("blockcopy", cfg, nb, bs), make)
+
+
 @dataclass
 class _Slot:
     """Host-side state of one occupied KV slot. The device holds the
@@ -235,6 +337,12 @@ class _Slot:
     recoveries: int = 0
     tenant: Optional[str] = None
     slo_class: Optional[str] = None
+    # chunked prefill (paged mode): next prompt index still to prefill;
+    # None once the final piece ran and the slot is decoding
+    pf_next: Optional[int] = None
+    # admission sequence number — preemption under pool pressure evicts
+    # the YOUNGEST slot (least sunk work)
+    born: int = 0
 
 
 @dataclass
@@ -281,6 +389,10 @@ class ContinuousBatchingEngine:
         seed: int = 0,
         min_bucket: int = 8,
         max_recoveries: int = 2,
+        block_size: int = 0,
+        pool_blocks: Optional[int] = None,
+        prefix_cache: bool = False,
+        prefill_chunk: int = 0,
         clock=time.monotonic,
     ):
         if max_slots < 1:
@@ -293,6 +405,44 @@ class ContinuousBatchingEngine:
             raise ValueError(
                 f"max_recoveries must be >= 0, got {max_recoveries}"
             )
+        # paged KV mode (block_size > 0): the cache is a pool of
+        # fixed-size blocks addressed through per-slot block tables —
+        # HBM scales with RESIDENT tokens, not slots x max_len, and
+        # admission gates on free blocks instead of free slots
+        self._paged = block_size > 0
+        if self._paged:
+            if max_len % block_size != 0:
+                raise ValueError(
+                    f"max_len {max_len} must be a multiple of "
+                    f"block_size {block_size}"
+                )
+            self._m = max_len // block_size  # table width (blocks/slot)
+            if pool_blocks is None:
+                # default: the contiguous engine's capacity + scratch —
+                # same HBM, pressure-free (the bench shrinks this)
+                pool_blocks = max_slots * self._m + 1
+            if pool_blocks < self._m + 1:
+                # usable pool must cover ONE full-length sequence, the
+                # invariant that makes preemption-to-fit always succeed
+                raise ValueError(
+                    f"pool_blocks {pool_blocks} < {self._m + 1} "
+                    f"(scratch + one full sequence of {self._m} blocks)"
+                )
+            if prefill_chunk < 0:
+                raise ValueError(
+                    f"prefill_chunk must be >= 0, got {prefill_chunk}"
+                )
+        elif prefix_cache or prefill_chunk:
+            raise ValueError(
+                "prefix_cache/prefill_chunk require block_size > 0"
+            )
+        else:
+            self._m = 0
+        self.block_size = int(block_size)
+        self.pool_blocks = int(pool_blocks) if self._paged else 0
+        self.prefill_chunk = int(prefill_chunk)
+        self._use_prefix = bool(prefix_cache)
+        self._admit_seq = 0
         self.params = params
         self.cfg = cfg
         self.max_slots = max_slots
@@ -343,19 +493,28 @@ class ContinuousBatchingEngine:
         self._ledger.register(self._ledger_owner, "params", pbytes, "params")
         weakref.finalize(self, self._ledger.release_owner, self._ledger_owner)
         self._alloc_device_state()
-        self._decode = _block_program(
-            cfg, max_slots, max_len, horizon, self._sampling
-        )
-        L, kvh, hd = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
-        cache_shape = (L, max_slots, max_len, kvh, hd)
+        if self._paged:
+            self._decode = _block_program_paged(
+                cfg, max_slots, self.pool_blocks, self._m,
+                self.block_size, horizon, self._sampling,
+            )
+            self._copyblk = _copy_block_program(
+                cfg, self.pool_blocks, self.block_size
+            )
+        else:
+            self._decode = _block_program(
+                cfg, max_slots, max_len, horizon, self._sampling
+            )
         log.info(
             "engine ready",
             slots=max_slots,
             max_len=max_len,
             horizon=horizon,
             cache_mb=round(
-                2 * np.prod(cache_shape) * np.dtype(cfg.dtype).itemsize
-                / 2**20, 1),
+                (self._kc.nbytes + self._vc.nbytes) / 2**20, 1),
+            paged=self._paged,
+            block_size=self.block_size,
+            pool_blocks=self.pool_blocks,
             sampling=self._sampling,
         )
 
@@ -374,9 +533,30 @@ class ContinuousBatchingEngine:
         self._drem = jnp.zeros(max_slots, jnp.int32)
         self._deos = jnp.full((max_slots,), -1, jnp.int32)
         L, kvh, hd = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
-        shape = (L, max_slots, max_len, kvh, hd)
-        self._kc = jnp.zeros(shape, cfg.dtype)
-        self._vc = jnp.zeros(shape, cfg.dtype)
+        if self._paged:
+            # block POOL, not slot slab — block 0 is SCRATCH (pads and
+            # frozen/inactive lanes write there, nothing reads it). The
+            # allocator, tables, and prefix cache are HOST truth
+            # rebuilt here from nothing: after a recovery the pool is
+            # zeros, so every prior block (including cached prefixes)
+            # is invalid and the re-prefill repopulates what it needs.
+            shape = (L, self.pool_blocks, self.block_size, kvh, hd)
+            self._kc = jnp.zeros(shape, cfg.dtype)
+            self._vc = jnp.zeros(shape, cfg.dtype)
+            self._balloc = _paged.BlockAllocator(
+                self.pool_blocks, self.block_size
+            )
+            self._prefix = (
+                _paged.PrefixCache(self._balloc) if self._use_prefix
+                else None
+            )
+            self._tables: List[List[int]] = [
+                [_paged.SCRATCH] * self._m for _ in range(max_slots)
+            ]
+        else:
+            shape = (L, max_slots, max_len, kvh, hd)
+            self._kc = jnp.zeros(shape, cfg.dtype)
+            self._vc = jnp.zeros(shape, cfg.dtype)
         # lanes whose slot was evicted while the DEVICE row was still
         # active (deadline evictions are host-bookkeeping only): blocks
         # dispatched before the eviction still carry the old request's
@@ -517,21 +697,44 @@ class ContinuousBatchingEngine:
                 # the freed slot admits this boundary, not next
                 emitted += self._drain_all()
             emitted += self._admit()
+        if self._paged:
+            # one bounded prefill chunk per prefilling slot per step,
+            # interleaved with the decode block below — a long prompt
+            # no longer starves running slots behind one monolithic
+            # prefill dispatch
+            emitted += self._advance_prefills()
         active_n = self.active_slots
         self.metrics.on_step(active_n, self.max_slots, self.queue.depth)
-        # live KV occupancy: tokens actually resident (prompt +
-        # committed generation, capped at the slot length) over the
-        # allocated capacity — the effective-concurrency-at-fixed-HBM
-        # figure ROADMAP item 1 (paged KV) must move
-        used = sum(
-            min(len(s.prompt) + len(s.generated), self.max_len)
-            for s in self._slots
-            if s is not None
+        if self._paged:
+            # block-aware occupancy: allocated blocks over the usable
+            # pool (scratch excluded) — the effective-concurrency-at-
+            # fixed-HBM figure ROADMAP item 1 wanted, plus the free-
+            # block headroom admission gates on
+            self._ledger.set_kv_usage(
+                self._ledger_owner, self._balloc.allocated_blocks,
+                self.pool_blocks - 1,
+            )
+            self._ledger.set_kv_blocks_free(
+                self._ledger_owner, self._balloc.free_blocks
+            )
+        else:
+            # live KV occupancy: tokens actually resident (prompt +
+            # committed generation, capped at the slot length) over the
+            # allocated capacity
+            used = sum(
+                min(len(s.prompt) + len(s.generated), self.max_len)
+                for s in self._slots
+                if s is not None
+            )
+            self._ledger.set_kv_usage(
+                self._ledger_owner, used, self.max_slots * self.max_len
+            )
+        # slots still mid-chunked-prefill have no decode state yet —
+        # the block dispatch runs only when someone is actually decoding
+        decoding = sum(
+            1 for s in self._slots if s is not None and s.pf_next is None
         )
-        self._ledger.set_kv_usage(
-            self._ledger_owner, used, self.max_slots * self.max_len
-        )
-        if active_n:
+        if decoding:
             self._dispatch_block()
             # double buffer: block k+1 is now on device; drain block k
             # (bookkeeping overlaps the device work, no idle bubble)
@@ -593,6 +796,23 @@ class ContinuousBatchingEngine:
                 )
 
     def _dispatch_block(self) -> None:
+        table = None
+        if self._paged:
+            # grow coverage BEFORE building the dispatch table: the
+            # block may advance each decoding slot past a block
+            # boundary, and coverage may preempt other slots under
+            # pool pressure — preempted rows then fall through to the
+            # all-scratch default below
+            for i, sl in enumerate(self._slots):
+                if sl is not None and sl.pf_next is None:
+                    self._ensure_cover(i)
+            tbl = np.zeros((self.max_slots, self._m), np.int32)
+            for i, sl in enumerate(self._slots):
+                if sl is not None and sl.pf_next is None:
+                    tbl[i] = self._tables[i]
+            # the table is a TRACED operand snapshot: alloc/share/free
+            # between dispatches are host bookkeeping, never a retrace
+            table = jnp.asarray(tbl)
         old = (self._dtok, self._dpos, self._dact, self._drem,
                self._kc, self._vc)
         # span measures the ENQUEUE cost only (the dispatch is async);
@@ -606,11 +826,20 @@ class ContinuousBatchingEngine:
         rids = [s.rid for s in self._slots if s is not None]
         with tracing.span("serving.dispatch", horizon=self.horizon,
                           rids=rids):
-            (toks, self._dtok, self._dpos, self._dact, self._drem,
-             self._kc, self._vc) = self._decode(
-                self.params, old[0], old[1], old[2], old[3], self._deos,
-                old[4], old[5], self._next_key(), self._temp(),
-            )
+            if self._paged:
+                (toks, self._dtok, self._dpos, self._dact, self._drem,
+                 self._kc, self._vc) = self._decode(
+                    self.params, old[0], old[1], old[2], old[3],
+                    self._deos, table, old[4], old[5],
+                    self._next_key(), self._temp(),
+                )
+            else:
+                (toks, self._dtok, self._dpos, self._dact, self._drem,
+                 self._kc, self._vc) = self._decode(
+                    self.params, old[0], old[1], old[2], old[3],
+                    self._deos, old[4], old[5],
+                    self._next_key(), self._temp(),
+                )
         self.metrics.on_dispatch("decode")
         # deliberate read of the donated refs: is_deleted() PROBES that
         # donation actually happened (the runtime half of this invariant)
@@ -622,7 +851,17 @@ class ContinuousBatchingEngine:
         # inputs are dead, the carries are rebound, and the block's
         # token matrix is about to be lost
         faults.fault_point("serve.dispatch")
-        self._inflight.append((toks, self.clock()))
+        # per-block lane membership: lane i's tokens belong to slot i's
+        # occupant AT DISPATCH — a lane mid-chunked-prefill (or later
+        # re-occupied) must not have this block's tokens replayed into
+        # it at drain (the device lane still carries a previous
+        # request's decode state until the final prefill piece resets
+        # it)
+        members = {
+            i: s.rid for i, s in enumerate(self._slots)
+            if s is not None and s.pf_next is None
+        }
+        self._inflight.append((toks, self.clock(), members))
 
     def _drain_one(self) -> int:
         """Sync the OLDEST in-flight block's [B, H] token matrix and
@@ -635,7 +874,7 @@ class ContinuousBatchingEngine:
             "serving.drain",
             rids=[s.rid for s in self._slots if s is not None],
         ):
-            blk, t_dispatch = self._inflight.popleft()
+            blk, t_dispatch, members = self._inflight.popleft()
             # chaos site: the popped block is lost on a crash here —
             # its tokens exist only on device, recovery must regenerate
             faults.fault_point("serve.drain")
@@ -656,6 +895,11 @@ class ContinuousBatchingEngine:
             sl = self._slots[i]
             if sl is None:
                 continue  # freed by an earlier drain; lanes are -1
+            if members.get(i) != sl.rid:
+                # lane belonged to a different occupant (or none) when
+                # this block dispatched — its tokens are not this
+                # request's
+                continue
             n = 0
             outcome = None
             for t in out[i]:
@@ -739,6 +983,13 @@ class ContinuousBatchingEngine:
                 break
             if self._shed_expired(req):
                 continue
+            if self._paged and not self._pg_admittable(req):
+                # admission gates on BLOCKS, not slots: the prompt's
+                # non-hit blocks must fit in free + cache-evictable
+                # pool right now. Head-of-line keeps its FIFO position
+                # and retries next boundary (drains free blocks).
+                self.queue.requeue_front(req)
+                break
             # queue wait ends at the pop — from here the clock charges
             # the prefill phase (the decomposition's first boundary)
             self.metrics.on_pop(req.rid)
@@ -755,10 +1006,40 @@ class ContinuousBatchingEngine:
                 # tokens across requests — sync them out first
                 emitted += self._drain_all()
             self._stale.discard(slot)
-            tok0 = self._prefill_into(
-                slot, req.prompt, req.max_new, req.eos_id,
-                site="serve.prefill", rid=req.rid,
-            )
+            if self._paged:
+                start = self._pg_setup_table(slot, req.prompt,
+                                             rid=req.rid)
+                if self.prefill_chunk and (
+                    len(req.prompt) - start > self.prefill_chunk
+                ):
+                    # long prompt: admit now with its blocks reserved,
+                    # prefill in bounded chunks interleaved with decode
+                    # blocks (_advance_prefills) instead of one
+                    # monolithic dispatch that starves running slots
+                    sl = _Slot(
+                        rid=req.rid, prompt=list(req.prompt),
+                        max_new=req.max_new, eos_id=req.eos_id,
+                        generated=[], deadline=req.deadline_at(),
+                        tenant=req.tenant, slo_class=req.slo_class,
+                        pf_next=start, born=self._admit_seq,
+                    )
+                    self._admit_seq += 1
+                    self._slots[slot] = sl
+                    self._admitting = None
+                    self.metrics.on_admit(req.rid, len(req.prompt))
+                    flight.emit("serve.admit", rid=req.rid, slot=slot,
+                                prompt_len=len(req.prompt), chunked=True)
+                    continue
+                tok0 = self._pg_prefill(
+                    slot, req.prompt, start, req.max_new, req.eos_id,
+                    site="serve.prefill", rid=req.rid,
+                )
+                self._pg_cache_insert(slot, req.prompt)
+            else:
+                tok0 = self._prefill_into(
+                    slot, req.prompt, req.max_new, req.eos_id,
+                    site="serve.prefill", rid=req.rid,
+                )
             self.metrics.on_admit(req.rid, len(req.prompt))
             flight.emit("serve.admit", rid=req.rid, slot=slot,
                         prompt_len=len(req.prompt))
@@ -767,7 +1048,9 @@ class ContinuousBatchingEngine:
                 eos_id=req.eos_id, generated=[tok0],
                 deadline=req.deadline_at(),
                 tenant=req.tenant, slo_class=req.slo_class,
+                born=self._admit_seq,
             )
+            self._admit_seq += 1
             self._slots[slot] = sl
             self._admitting = None
             self.metrics.on_token(req.rid)
@@ -795,6 +1078,13 @@ class ContinuousBatchingEngine:
         the prompt) and crash recovery (``seq`` = prompt + generated —
         greedy argmax over the full context emits exactly the token the
         lost decode step would have)."""
+        if self._paged:
+            start = self._pg_setup_table(slot, seq, rid=rid)
+            tok0 = self._pg_prefill(slot, seq, start, max_new, eos_id,
+                                    site=site, rid=rid, replay=replay)
+            if not replay:
+                self._pg_cache_insert(slot, seq)
+            return tok0
         t0 = len(seq)
         tb = self._bucket(t0)
         toks = np.zeros((1, tb), np.int32)
@@ -848,6 +1138,322 @@ class ContinuousBatchingEngine:
             self._t_eff_last = now
             return first
 
+    # -- paged KV management ------------------------------------------------
+    #
+    # Everything below is HOST bookkeeping over edl_tpu/serving/paged.py
+    # — allocation, prefix sharing, copy-on-write, preemption, frees.
+    # The device only ever sees a snapshot block table per dispatch.
+    #
+    # Eviction/reuse safety rides on device program ordering: an
+    # in-flight block dispatched with the OLD table executes before any
+    # later-dispatched prefill that reuses a freed block (single-stream
+    # execution), and the new owner rewrites every position it will
+    # read before reading it — so a stale lane's writes into a
+    # reclaimed block are always overwritten before they are observed.
+
+    def _pg_admittable(self, req: Request) -> bool:
+        """Paged admission gate: the prompt's non-hit blocks must fit
+        in the pool right now (free + cache-evictable). Decode-time
+        growth is NOT reserved — it comes from later frees or from
+        preempting the youngest slot (``pool_blocks >= m + 1`` makes a
+        lone request always able to finish)."""
+        hits = 0
+        if self._prefix is not None:
+            hits = len(self._prefix.match(req.prompt))
+        needed = max(
+            _paged.blocks_for(len(req.prompt), self.block_size) - hits, 1
+        )
+        avail = self._balloc.free_blocks
+        if self._prefix is not None:
+            avail += self._prefix.evictable()
+        return avail >= needed
+
+    def _pg_setup_table(self, slot: int, seq: List[int],
+                        rid: Optional[str] = None) -> int:
+        """Build slot ``slot``'s block table for ``seq``: map prefix-
+        cache hits as SHARED entries (one ref each), allocate private
+        blocks for the rest, and return the position prefill starts at
+        (hit positions are already resident — their prefill is
+        skipped). A FULL hit still re-prefills the last prompt token
+        (the logits source for the first generated token), so the final
+        shared block is copy-on-written first."""
+        tbl = self._tables[slot]
+        assert all(b == _paged.SCRATCH for b in tbl), (
+            f"slot {slot} table not clean at setup: {tbl}"
+        )
+        bs = self.block_size
+        hits: List[int] = []
+        if self._prefix is not None:
+            hits = self._prefix.match(seq)
+            self._prefix.hits += len(hits)
+            if not hits:
+                self._prefix.misses += 1
+        nb = _paged.blocks_for(len(seq), bs)
+        full = nb > 0 and len(hits) == nb  # only when len(seq) % bs == 0
+        start = len(seq) - 1 if full else len(hits) * bs
+        for j, bid in enumerate(hits):
+            self._balloc.incref(bid)
+            tbl[j] = bid
+        for j in range(len(hits), nb):
+            tbl[j] = self._pg_alloc_or_preempt(slot)
+        if full:
+            self._pg_make_writable(slot, nb - 1)
+        if hits:
+            self._ledger.count_prefix_hits(len(hits))
+            flight.emit("serve.prefix_hit", rid=rid,
+                        blocks=len(hits), full=full)
+        return start
+
+    def _pg_prefill(self, slot: int, seq: List[int], start: int,
+                    max_new: int, eos_id: Optional[int],
+                    site: Optional[str] = None, rid: Optional[str] = None,
+                    replay: bool = False) -> int:
+        """Prefill positions ``start..len(seq)-1`` into the slot's
+        mapped blocks and return the first generated token. With
+        ``prefill_chunk`` set the leading pieces run as bounded chunk
+        dispatches INLINE here (admission defers long prompts to
+        ``_advance_prefills`` instead — this inline loop serves replay,
+        where interleaving has no one to yield to)."""
+        chunk = self.prefill_chunk
+        if chunk:
+            while len(seq) - start > chunk:
+                self._dispatch_prefill_chunk(slot, seq, start,
+                                             rid=rid, site=site)
+                start += chunk
+        return self._dispatch_prefill_final(
+            slot, seq, start, max_new, eos_id,
+            site=site, rid=rid, replay=replay,
+        )
+
+    def _dispatch_prefill_chunk(self, slot: int, seq: List[int],
+                                start: int, rid: Optional[str] = None,
+                                site: Optional[str] = None) -> None:
+        """One non-final prefill chunk: K/V for ``prefill_chunk``
+        prompt tokens written into the slot's blocks, no logits, no
+        slot-state reset — pools donated like every other dispatch."""
+        c = self.prefill_chunk
+        toks = np.asarray(seq[start:start + c], np.int32)[None, :]
+        t_pf = self.clock()
+        prog = _prefill_chunk_program(self.cfg, c, self.block_size)
+        table = jnp.asarray(np.asarray(self._tables[slot], np.int32))
+        old = (self._kc, self._vc)
+        with tracing.span("serving.prefill", bucket=c, rid=rid,
+                          chunk=True):
+            self._kc, self._vc = prog(
+                self.params, jnp.asarray(toks), jnp.int32(start),
+                old[0], old[1], table,
+            )
+            self.metrics.on_dispatch("prefill")
+            # edl: no-lint[donation-safety] deliberate is_deleted() probe of the donation contract
+            self._assert_donated(*old)
+            flight.emit("serve.prefill_chunk", rid=rid, slot=slot,
+                        start=start, chunk=c)
+            if site is not None:
+                faults.fault_point(site)
+            now = self.clock()
+            self._eff.observe(
+                "prefill", self._cost.prefill(c),
+                now - max(self._t_eff_last, t_pf),
+            )
+            self._t_eff_last = now
+
+    def _dispatch_prefill_final(
+        self, slot: int, seq: List[int], start: int, max_new: int,
+        eos_id: Optional[int], site: Optional[str] = None,
+        rid: Optional[str] = None, replay: bool = False,
+    ) -> int:
+        """The paged analog of the contiguous prefill dispatch: run the
+        bucketed TAIL of ``seq`` (positions ``start..``), sample the
+        first token, and reset the slot's device decode state. Earlier
+        positions are already resident (prefix hits / chunks)."""
+        n = len(seq) - start
+        tb = self._bucket(n)
+        toks = np.zeros((1, tb), np.int32)
+        toks[0, :n] = seq[start:]
+        t_pf = self.clock()
+        prefill = _prefill_paged_program(
+            self.cfg, tb, self.block_size, self._sampling
+        )
+        table = jnp.asarray(np.asarray(self._tables[slot], np.int32))
+        old = (self._dtok, self._dpos, self._dact, self._drem,
+               self._deos, self._kc, self._vc)
+        rid_root = (
+            disttrace.root("rid", rid) if rid is not None
+            else contextlib.nullcontext()
+        )
+        with rid_root, tracing.span("serving.prefill", bucket=tb, rid=rid):
+            (tok0, self._dtok, self._dpos, self._dact, self._drem,
+             self._deos, self._kc, self._vc) = prefill(
+                self.params,
+                jnp.asarray(toks),
+                jnp.int32(start),
+                jnp.int32(n - 1),
+                jnp.int32(slot),
+                jnp.int32(max_new),
+                jnp.int32(-1 if eos_id is None else eos_id),
+                old[0], old[1], old[2], old[3], old[4], old[5], old[6],
+                table,
+                self._next_key(),
+                self._temp(),
+            )
+            self.metrics.on_dispatch("prefill")
+            # edl: no-lint[donation-safety] deliberate is_deleted() probe of the donation contract
+            self._assert_donated(*old)
+            flight.emit("serve.prefill", rid=rid, slot=slot, bucket=tb,
+                        replay=replay, start=start)
+            if site is not None:
+                faults.fault_point(site)
+            first = int(np.asarray(tok0))
+            now = self.clock()
+            self._eff.observe(
+                "prefill", self._cost.prefill(tb),
+                now - max(self._t_eff_last, t_pf),
+            )
+            self._t_eff_last = now
+            return first
+
+    def _advance_prefills(self) -> int:
+        """One bounded chunk per chunk-prefilling slot per step — the
+        interleave that keeps decode blocks flowing while long prompts
+        prefill. The FINAL piece lands the first token and flips the
+        slot to decoding."""
+        emitted = 0
+        for i in range(self.max_slots):
+            sl = self._slots[i]
+            if sl is None or sl.pf_next is None:
+                continue
+            start = sl.pf_next
+            if len(sl.prompt) - start > self.prefill_chunk:
+                self._dispatch_prefill_chunk(
+                    i, sl.prompt, start, rid=sl.rid, site="serve.prefill"
+                )
+                sl.pf_next = start + self.prefill_chunk
+                continue
+            sl.pf_next = None
+            tok0 = self._dispatch_prefill_final(
+                i, sl.prompt, start, sl.max_new, sl.eos_id,
+                site="serve.prefill", rid=sl.rid,
+            )
+            self._pg_cache_insert(i, sl.prompt)
+            sl.generated.append(tok0)
+            self.metrics.on_token(sl.rid)
+            emitted += 1
+            if sl.eos_id is not None and tok0 == sl.eos_id:
+                self._finish(i, "eos")
+            elif sl.max_new <= 1:
+                self._finish(i, "done")
+        return emitted
+
+    def _pg_cache_insert(self, slot: int, prompt: List[int]) -> None:
+        """Publish the slot's FULL prompt blocks into the prefix cache
+        (chain keys — a hit implies the whole prefix matched). Existing
+        keys are no-op touches, so identical prompts converge on the
+        first publisher's blocks."""
+        if self._prefix is None:
+            return
+        tbl = self._tables[slot]
+        for j, key in enumerate(
+            _paged.chain_keys(prompt, self.block_size)
+        ):
+            self._prefix.insert(key, tbl[j])
+
+    def _ensure_cover(self, i: int) -> None:
+        """Alloc-on-demand as ``pos`` crosses block boundaries: before
+        a decode dispatch, map every block the slot's ACTIVE lane can
+        write within the next ``horizon * (in-flight + 1)`` positions
+        (in-flight blocks advance the device past the host view).
+        Frozen-lane rewrites past the budget route to scratch on
+        device and are masked on read, so they need no coverage."""
+        sl = self._slots[i]
+        t0 = len(sl.prompt) + len(sl.generated)
+        need = min(
+            self.max_len,
+            len(sl.prompt) + sl.max_new,
+            t0 + self.horizon * (len(self._inflight) + 1),
+        )
+        tbl = self._tables[i]
+        for j in range(_paged.blocks_for(need, self.block_size)):
+            if tbl[j] == _paged.SCRATCH:
+                tbl[j] = self._pg_alloc_or_preempt(i)
+
+    def _pg_alloc_or_preempt(self, slot: int) -> int:
+        """One block, by any means: the free list, then evicting
+        refcount-1 prefix-cache entries (LRU), then preempting the
+        youngest OTHER slot back to the queue. The construction
+        invariant (usable pool >= one full sequence) means a lone
+        survivor always gets its block."""
+        while True:
+            bid = self._balloc.alloc()
+            if bid is not None:
+                return bid
+            if self._prefix is not None and self._prefix.evict_one():
+                continue
+            if not self._pg_preempt(exclude=slot):
+                raise RuntimeError(
+                    "KV pool exhausted with nothing left to preempt"
+                )
+
+    def _pg_preempt(self, exclude: int) -> bool:
+        """Preempt the youngest slot (≠ ``exclude``) under pool
+        pressure: free its blocks, mark the lane stale, and requeue the
+        request AT THE HEAD for restart-by-recomputation. ``submit_s=0``
+        with the ABSOLUTE deadline keeps ``deadline_at()`` correct
+        across the round trip."""
+        victims = [
+            (sl.born, i) for i, sl in enumerate(self._slots)
+            if sl is not None and i != exclude
+        ]
+        if not victims:
+            return False
+        _, i = max(victims)
+        sl = self._slots[i]
+        flight.emit("serve.preempt", severity="warn", rid=sl.rid,
+                    slot=i, generated=len(sl.generated))
+        self._pg_free_slot(i)
+        self._slots[i] = None
+        self._stale.add(i)
+        self.queue.requeue_front(Request(
+            rid=sl.rid, prompt=list(sl.prompt), max_new=sl.max_new,
+            eos_id=sl.eos_id, deadline_s=sl.deadline, submit_s=0.0,
+            recoveries=sl.recoveries, tenant=sl.tenant,
+            slo_class=sl.slo_class,
+        ))
+        return True
+
+    def _pg_free_slot(self, i: int) -> None:
+        """Drop the slot's reference on every mapped block. Free and
+        table-clear happen TOGETHER — a freed id left behind in a table
+        is the aliasing hazard the kv-block check rule flags. Shared
+        blocks survive under their remaining refs (prefix cache /
+        other slots); reclaimed ones are rewritten by their next owner
+        before any read (program ordering, see section comment)."""
+        tbl = self._tables[i]
+        for j, bid in enumerate(tbl):
+            if bid != _paged.SCRATCH:
+                self._balloc.free(bid)
+                tbl[j] = _paged.SCRATCH
+
+    def _pg_make_writable(self, slot: int, j: int) -> None:
+        """Copy-on-write table entry ``j``: if the mapped block is
+        shared (refcount > 1), copy it into a private block on device,
+        point the table at the copy, and drop the shared ref. Shared
+        blocks are immutable while referenced — this is the only path
+        that lets a slot write into previously shared territory."""
+        tbl = self._tables[slot]
+        bid = tbl[j]
+        if self._balloc.refcount(bid) <= 1:
+            return
+        dst = self._pg_alloc_or_preempt(slot)
+        old = (self._kc, self._vc)
+        self._kc, self._vc = self._copyblk(
+            old[0], old[1], jnp.int32(bid), jnp.int32(dst)
+        )
+        # edl: no-lint[donation-safety] deliberate is_deleted() probe of the donation contract
+        self._assert_donated(*old)
+        tbl[j] = dst
+        self._balloc.free(bid)
+        flight.emit("serve.kv_cow", slot=slot, block=j)
+
     def _finish(self, slot: int, outcome: str) -> None:
         sl = self._slots[slot]
         self.results[sl.rid] = RequestResult(
@@ -875,7 +1481,11 @@ class ContinuousBatchingEngine:
         # eviction is bookkeeping only: the device already froze the
         # row (active mask), the freed cache row is dead weight until
         # the next prefill-insert overwrites it, and the block program
-        # never changes shape
+        # never changes shape. Paged mode additionally returns the
+        # slot's block references to the pool (shared prefix blocks
+        # survive under the cache's ref).
+        if self._paged:
+            self._pg_free_slot(slot)
         self._slots[slot] = None
 
     # -- crash recovery ------------------------------------------------------
@@ -970,6 +1580,9 @@ class ContinuousBatchingEngine:
         the tokens still owed. EOS/budget termination is re-checked on
         the emitted token exactly like admission."""
         sl = self._slots[slot]
+        # a slot caught mid-chunked-prefill replays its whole prompt
+        # inline — the fresh pool has none of its earlier chunks
+        sl.pf_next = None
         seq = sl.prompt + sl.generated
         remaining = sl.max_new - len(sl.generated)
         tok = self._prefill_into(slot, seq, remaining, sl.eos_id,

@@ -110,6 +110,15 @@ class WorkloadSpec:
     burst_factor: float = 4.0  # burst-state rate multiplier (MMPP)
     burst_dwell_s: float = 1.0  # mean dwell per MMPP state
     vocab: int = 512
+    # shared-prefix traffic (paged-KV prefix cache measurement): each
+    # tenant gets one fixed system-prompt template of
+    # ``shared_prefix_len`` tokens; with probability
+    # ``shared_prefix_frac`` a request's leading prompt tokens are
+    # REPLACED by its tenant's template. 0.0 (the default) draws
+    # NOTHING extra from the rng — specs without the knob stay
+    # byte-identical to pre-knob builds (the CI cmp gate).
+    shared_prefix_frac: float = 0.0
+    shared_prefix_len: int = 12
     tenants: Tuple[TenantSpec, ...] = field(default_factory=default_tenants)
     classes: Tuple[SLOClass, ...] = field(default_factory=default_classes)
 
@@ -228,14 +237,42 @@ def build(spec: WorkloadSpec) -> List[GenRequest]:
     missing = {t.slo_class for t in spec.tenants} - set(cmap)
     if missing:
         raise ValueError(f"tenants reference unknown SLO classes {sorted(missing)}")
+    if not 0.0 <= spec.shared_prefix_frac <= 1.0:
+        raise ValueError(
+            f"shared_prefix_frac must be in [0, 1], got "
+            f"{spec.shared_prefix_frac}"
+        )
+    if spec.shared_prefix_len < 1:
+        raise ValueError(
+            f"shared_prefix_len must be >= 1, got {spec.shared_prefix_len}"
+        )
     rng = np.random.RandomState(spec.seed)
     arrivals = _arrival_times(spec, rng)
+    # per-tenant system-prompt templates, drawn ONCE and only when the
+    # knob is on — the frac=0 path's draw sequence is untouched, so
+    # pre-knob workloads reproduce byte-for-byte
+    templates: Dict[str, List[int]] = {}
+    if spec.shared_prefix_frac > 0:
+        for t in spec.tenants:
+            templates[t.name] = [
+                int(x)
+                for x in rng.randint(0, spec.vocab, spec.shared_prefix_len)
+            ]
     reqs: List[GenRequest] = []
     for i, at in enumerate(arrivals):
         t = _pick_tenant(rng, spec.tenants)
         plen = _lognormal_int(rng, t.prompt_mean, t.prompt_sigma, 1, t.prompt_max)
         prompt = rng.randint(0, spec.vocab, plen).tolist()
         max_new = _lognormal_int(rng, t.output_mean, t.output_sigma, 1, t.output_max)
+        if spec.shared_prefix_frac > 0:
+            # the extra draw happens ONLY behind the gate, after the
+            # existing per-request draws — draw-order stability
+            if float(rng.rand()) < spec.shared_prefix_frac:
+                tpl = templates[t.name]
+                # keep at least one tenant-specific trailing token so
+                # identical-template requests still diverge
+                k = min(len(tpl), max(plen - 1, 0))
+                prompt[:k] = tpl[:k]
         c = cmap[t.slo_class]
         reqs.append(
             GenRequest(

@@ -98,12 +98,14 @@ def build_workload(n_requests, vocab, rng):
     return reqs
 
 
-def run_serving(params, cfg, reqs, *, horizon, max_recoveries=2):
+def run_serving(params, cfg, reqs, *, horizon, max_recoveries=2,
+                block_size=0, prefix_cache=False):
     from edl_tpu.serving.engine import ContinuousBatchingEngine
 
     eng = ContinuousBatchingEngine(
         params, cfg, max_slots=3, max_len=64, horizon=horizon,
         max_recoveries=max_recoveries,
+        block_size=block_size, prefix_cache=prefix_cache,
     )
     pending = sorted(reqs, key=lambda r: r["arrive"])
     i = step = 0
@@ -140,14 +142,21 @@ def serving_lane(seed, n_requests, horizon=4, events_dir=None):
     from edl_tpu.obs import postmortem as pm
 
     cfg = llama.LlamaConfig.tiny(vocab=256)
+    # the chaos lane runs the PAGED engine (block pool + prefix cache)
+    # so crash/recovery is exercised against block tables, shared
+    # prefix blocks, and the allocator rebuild — not just the simple
+    # contiguous slab.
+    block_size = 8
+    pool_blocks = 3 * (64 // block_size) + 1  # engine default, + scratch
     # the memory-ledger no-drift contract: after ANY number of
     # crash/recover cycles an engine's KV entry must be EXACTLY one
-    # cache's bytes — _recover -> _alloc_device_state re-registers
+    # pool's bytes — _recover -> _alloc_device_state re-registers
     # under the same key (replace, never add), so recoveries cannot
     # leak ledger bytes (ISSUE 8 satellite; kv itemsize follows the
-    # engine's cfg.dtype)
-    expected_kv = cm.kv_cache_bytes(
-        cfg, slots=3, max_len=64,
+    # engine's cfg.dtype). Paged mode pins POOL accounting: the
+    # [L, pool_blocks, block_size, KV, hd] pair, scratch included.
+    expected_kv = cm.kv_pool_bytes(
+        cfg, n_blocks=pool_blocks, block_size=block_size,
         bytes_per_el=_np.dtype(cfg.dtype).itemsize,
     )
 
@@ -170,7 +179,8 @@ def serving_lane(seed, n_requests, horizon=4, events_dir=None):
 
     faults.disarm()
     recorder.clear()
-    ref_eng = run_serving(params, cfg, reqs, horizon=horizon)
+    ref_eng = run_serving(params, cfg, reqs, horizon=horizon,
+                          block_size=block_size, prefix_cache=True)
     ref = {rid: r.tokens for rid, r in ref_eng.results.items()}
     assert len(ref) == len(reqs), "fault-free run lost requests"
     assert ref_eng.recoveries == 0
@@ -188,7 +198,8 @@ def serving_lane(seed, n_requests, horizon=4, events_dir=None):
         before = injected_total()
         faults.arm(plan, seed=seed)
         eng = run_serving(params, cfg, reqs, horizon=horizon,
-                          max_recoveries=3)
+                          max_recoveries=3,
+                          block_size=block_size, prefix_cache=True)
         faults.disarm()
         fired = injected_total() - before
         res = eng.results

@@ -157,6 +157,60 @@ def sweep_horizons(params, cfg, reqs, slots, max_len, horizons, check=False):
     return rows
 
 
+def check_prefix_cache(params, cfg) -> None:
+    """The warm shared-prefix contract (run_tests.sh phase 4): serving
+    the SAME multi-block prompt twice through a paged engine must back
+    the shared portion with cached KV blocks. The dispatch-counter
+    delta PROVES the skip: cold admission of a 4-block prompt at
+    ``prefill_chunk == block_size`` costs 4 prefill dispatches (3
+    chunks + the final piece); the warm run is a full-chain hit, so
+    every shared block costs ZERO prefill dispatches and only the
+    single copy-on-write last-token dispatch remains. Tokens must be
+    identical — reuse may never change outputs."""
+    from edl_tpu.serving.engine import ContinuousBatchingEngine
+    from edl_tpu.serving.metrics import ServingMetrics
+
+    bs = 8
+    metrics = ServingMetrics()
+    eng = ContinuousBatchingEngine(
+        params, cfg, max_slots=2, max_len=64, horizon=4,
+        metrics=metrics, block_size=bs, prefix_cache=True,
+        prefill_chunk=bs,
+    )
+    prompt = [(7 * i + 3) % cfg.vocab for i in range(4 * bs)]
+
+    def serve(rid):
+        before = metrics.snapshot()["dispatches_prefill"]
+        eng.submit(rid, prompt, 6)
+        while eng.has_work:
+            eng.step()
+        disp = metrics.snapshot()["dispatches_prefill"] - before
+        return disp, list(eng.results[rid].tokens)
+
+    cold_disp, cold_toks = serve("prefix-cold")
+    assert cold_disp == 4, (
+        f"cold 4-block prompt took {cold_disp} prefill dispatches; "
+        f"expected 3 chunks + 1 final"
+    )
+    hits0 = eng._prefix.hits
+    warm_disp, warm_toks = serve("prefix-warm")
+    assert warm_toks == cold_toks, (
+        f"warm prefix hit changed tokens:\n  cold {cold_toks}\n"
+        f"  warm {warm_toks}"
+    )
+    assert warm_disp == 1, (
+        f"warm full-prefix hit took {warm_disp} prefill dispatches; "
+        f"the shared blocks must cost ZERO (1 last-token dispatch only)"
+    )
+    assert eng._prefix.hits - hits0 == 4, (
+        f"prefix-hit counter advanced {eng._prefix.hits - hits0}, "
+        f"want 4 (one per shared block)"
+    )
+    print(f"prefix cache OK: cold={cold_disp} warm={warm_disp} prefill "
+          f"dispatches, {eng._prefix.hits - hits0} block hits, "
+          f"tokens identical")
+
+
 def check_scrape(exporter) -> None:
     """The CI exposition contract (run_tests.sh phase 4): GET /metrics
     must return valid Prometheus text with the serving series NON-ZERO
@@ -279,6 +333,7 @@ def main() -> None:
         deep = build_workload(8, cfg.vocab, rng, on_tpu, deep=True)
         sweep_horizons(params, cfg, deep, slots, max(max_len, 96),
                        sorted(set(horizons) | {1, 8}), check=True)
+        check_prefix_cache(params, cfg)
         if exporter is not None:
             check_scrape(exporter)
             exporter.stop()

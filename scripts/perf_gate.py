@@ -90,6 +90,15 @@ METRICS: Dict[str, MetricSpec] = {
     "serving_ttft_slo_attainment": MetricSpec(
         +1, 0.10, "serving_goodput_config"
     ),
+    # paged KV rungs: block-packing concurrency at a fixed HBM budget
+    # (counts, deterministic) and warm prefix-hit TTFT (wall-clock;
+    # wide tolerance for host timing noise on tiny CPU models)
+    "serving_effective_concurrency_at_fixed_hbm": MetricSpec(
+        +1, 0.15, "serving_paged_config"
+    ),
+    "serving_prefix_hit_ttft_ms": MetricSpec(
+        -1, 0.30, "serving_paged_config"
+    ),
     # elastic protocol (lower is better; tunneled-chip timing noise)
     "reshard_stall_s": MetricSpec(-1, 0.25),
     "reshard_stall_host_fallback_s": MetricSpec(-1, 0.25),

@@ -57,7 +57,11 @@ echo "== phase 3 done in $((t3 - t2))s (rc=$rc3) =="
 echo "== phase 4: serving dispatch-bound + telemetry smoke (exp_serving --dryrun) =="
 # hard-asserts dispatches/token <= 1/H + admission overhead and the
 # >=4x H=8-vs-H=1 reduction, so the fused decode loop can't silently
-# regress to per-token dispatch. --metrics-port 0 additionally brings
+# regress to per-token dispatch. Also asserts the warm shared-prefix
+# contract on the paged engine: serving an identical 4-block prompt
+# twice must issue ZERO prefill dispatches for the cached blocks on
+# the warm pass (dispatch-counter delta: 4 cold vs 1 warm) with
+# byte-identical tokens. --metrics-port 0 additionally brings
 # up the obs exporter and self-scrapes /metrics, hard-asserting the
 # key series (TTFT histogram, dispatch counters, queue gauge) are
 # present and non-zero — the Prometheus exposition path is CI-pinned.

@@ -472,6 +472,77 @@ def test_telemetry_fault_site_coverage(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# kv-block
+
+
+def test_kv_block_free_without_table_clear_is_flagged(tmp_path):
+    rep = run_on(tmp_path, """
+    class Engine:
+        def evict(self, i):
+            tbl = self._tables[i]
+            for j, bid in enumerate(tbl):
+                if bid != 0:
+                    self._balloc.free(bid)  # table entry never cleared
+    """, rules=["kv-block"])
+    assert rules_of(rep) == ["kv-block"]
+    assert "'bid'" in rep.findings[0].message
+    assert "table" in rep.findings[0].message
+    assert rep.findings[0].severity == "error"
+
+
+def test_kv_block_free_with_table_clear_is_clean(tmp_path):
+    rep = run_on(tmp_path, """
+    SCRATCH = 0
+
+    class Engine:
+        def evict(self, i):
+            tbl = self._tables[i]
+            for j, bid in enumerate(tbl):
+                if bid != SCRATCH:
+                    self._balloc.free(bid)
+                    tbl[j] = SCRATCH
+
+        def cow(self, slot, j):
+            tbl = self._tables[slot]
+            bid = tbl[j]
+            dst = self._balloc.alloc()
+            tbl[j] = dst
+            self._balloc.free(bid)
+    """, rules=["kv-block"])
+    assert rep.findings == []
+
+
+def test_kv_block_non_table_free_is_exempt(tmp_path):
+    # the prefix cache freeing its own map entries references no
+    # table — refcount-only releases are not the hazard
+    rep = run_on(tmp_path, """
+    class PrefixCache:
+        def evict_one(self):
+            for key, bid in self._map.items():
+                if self._alloc.refcount(bid) == 1:
+                    del self._map[key]
+                    self._alloc.free(bid)
+                    return True
+            return False
+    """, rules=["kv-block"])
+    assert rep.findings == []
+
+
+def test_kv_block_suppression(tmp_path):
+    rep = run_on(tmp_path, """
+    class Engine:
+        def drop(self, i):
+            tbl = self._tables[i]
+            bid = tbl[0]
+            # edl: no-lint[kv-block] table discarded wholesale below
+            self._balloc.free(bid)
+            del self._tables[i]
+    """, rules=["kv-block"])
+    assert rep.findings == []
+    assert rep.suppressed == 1
+
+
+# ---------------------------------------------------------------------------
 # baseline round-trip + framework
 
 

@@ -46,6 +46,64 @@ def test_same_seed_byte_identical(arrival):
     assert loadgen.workload_jsonl(loadgen.build(other)) != a
 
 
+def test_shared_prefix_zero_is_byte_identical_to_default():
+    """The knob's off-position draws NOTHING from the rng stream —
+    pre-knob workload bytes are preserved (the CI cmp gate in
+    run_tests.sh phase 7 depends on it)."""
+    base = loadgen.WorkloadSpec(seed=9, n_requests=32, rate_rps=16.0)
+    off = loadgen.WorkloadSpec(
+        seed=9, n_requests=32, rate_rps=16.0, shared_prefix_frac=0.0
+    )
+    assert (
+        loadgen.workload_jsonl(loadgen.build(base))
+        == loadgen.workload_jsonl(loadgen.build(off))
+    )
+
+
+def test_shared_prefix_injects_per_tenant_templates():
+    """With the knob on, ~frac of each tenant's requests start with
+    ONE fixed template (drawn once per tenant), the rest stay fully
+    random — and the workload is still seed-deterministic and inside
+    the tenant prompt bounds."""
+    spec = loadgen.WorkloadSpec(
+        seed=9, n_requests=200, rate_rps=16.0,
+        shared_prefix_frac=0.6, shared_prefix_len=6,
+    )
+    reqs = loadgen.build(spec)
+    assert loadgen.workload_jsonl(loadgen.build(spec)) == (
+        loadgen.workload_jsonl(reqs)
+    )
+    shared = total = 0
+    by_tenant = {}
+    for r in reqs:
+        if len(r.prompt) > spec.shared_prefix_len + 1:
+            by_tenant.setdefault(r.tenant, []).append(
+                tuple(r.prompt[: spec.shared_prefix_len])
+            )
+    for prefixes in by_tenant.values():
+        counts = {}
+        for p in prefixes:
+            counts[p] = counts.get(p, 0) + 1
+        shared += max(counts.values())  # the template's share
+        total += len(prefixes)
+    assert 0.4 <= shared / total <= 0.8, (shared, total)
+    tenants = {t.name: t for t in spec.tenants}
+    for r in reqs:
+        assert 1 <= len(r.prompt) <= tenants[r.tenant].prompt_max
+        assert all(0 <= tok < spec.vocab for tok in r.prompt)
+
+
+def test_shared_prefix_validation():
+    with pytest.raises(ValueError):
+        loadgen.build(loadgen.WorkloadSpec(shared_prefix_frac=1.5))
+    with pytest.raises(ValueError):
+        loadgen.build(
+            loadgen.WorkloadSpec(
+                shared_prefix_frac=0.5, shared_prefix_len=0
+            )
+        )
+
+
 def test_workload_shape_and_bounds():
     spec = loadgen.WorkloadSpec(seed=0, n_requests=64, rate_rps=16.0)
     reqs = loadgen.build(spec)

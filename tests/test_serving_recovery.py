@@ -43,14 +43,15 @@ PROMPTS = [list(range(2, 2 + n)) for n in (4, 7, 3, 9, 5, 6)]
 MAX_NEWS = [6, 3, 13, 5, 7, 9]
 
 
-def _run_mixed(horizon=4, max_recoveries=2, plan=None, seed=0):
+def _run_mixed(horizon=4, max_recoveries=2, plan=None, seed=0,
+               **engine_kw):
     """The mid-stream workload: 3 requests in, one block dispatched,
     3 more join — so a crash lands with requests at different depths."""
     if plan:
         faults.arm(plan, seed=seed)
     eng = ContinuousBatchingEngine(
         PARAMS, CFG, max_slots=3, max_len=64, horizon=horizon,
-        max_recoveries=max_recoveries,
+        max_recoveries=max_recoveries, **engine_kw,
     )
     for i in range(3):
         eng.submit(f"r{i}", PROMPTS[i], MAX_NEWS[i])
@@ -60,6 +61,28 @@ def _run_mixed(horizon=4, max_recoveries=2, plan=None, seed=0):
     res = eng.run()
     faults.disarm()
     return eng, res
+
+
+def test_paged_dispatch_fault_token_identity():
+    """The recovery contract holds with the PAGED cache: a crash
+    mid-dispatch discards the block pool, and ``_recover`` rebuilds
+    allocator, tables, and prefix cache from host truth before the
+    re-prefill — greedy tokens stay identical and no pool blocks leak
+    (the deep paged recovery matrix lives in tests/test_paged_kv.py)."""
+    eng, res = _run_mixed(
+        plan="serve.dispatch:raise@n=3",
+        block_size=8, prefix_cache=True,
+    )
+    assert set(res) == {f"r{i}" for i in range(6)}
+    for i in range(6):
+        assert res[f"r{i}"].tokens == _sequential(PROMPTS[i], MAX_NEWS[i]), (
+            f"r{i} diverged after paged crash recovery"
+        )
+        assert res[f"r{i}"].outcome in ("done", "eos")
+    assert eng.recoveries >= 1
+    # every allocated block is accounted for by the prefix cache —
+    # finished slots returned theirs to the pool
+    assert eng._balloc.allocated_blocks == len(eng._prefix)
 
 
 def test_dispatch_fault_token_identity():
