@@ -233,10 +233,20 @@ class Report:
     files: int = 0
     duration_s: float = 0.0
     errors: List[str] = field(default_factory=list)  # unparseable files
+    # per-rule counts — {"rule-id": {"findings": N, "baselined": N,
+    # "suppressed": N}} — the machine-readable block the CI phase-0
+    # gate log prints so a creeping suppression count is visible
+    rule_stats: Dict[str, Dict[str, int]] = field(default_factory=dict)
 
     @property
     def failed(self) -> bool:
         return bool(self.findings or self.errors)
+
+    def _bump(self, rule: str, bucket: str) -> None:
+        st = self.rule_stats.setdefault(
+            rule, {"findings": 0, "baselined": 0, "suppressed": 0}
+        )
+        st[bucket] += 1
 
 
 def _walk_py(paths: Sequence[str]) -> List[str]:
@@ -305,6 +315,7 @@ def run_check(
         ctx = by_rel.get(f.path)
         if ctx is not None and ctx.suppressed(f.rule, f.line):
             report.suppressed += 1
+            report._bump(f.rule, "suppressed")
         else:
             kept.append(f)
 
@@ -321,6 +332,10 @@ def run_check(
         report.findings = sorted(kept, key=lambda x: (x.path, x.line, x.rule))
 
     report.findings.sort(key=lambda x: (x.path, x.line, x.rule))
+    for f in report.findings:
+        report._bump(f.rule, "findings")
+    for f in report.baselined:
+        report._bump(f.rule, "baselined")
     report.duration_s = time.perf_counter() - t0
     return report
 
@@ -355,6 +370,9 @@ def render_json(report: Report) -> str:
         "findings": [f.to_record() for f in report.findings],
         "baselined": [f.to_record() for f in report.baselined],
         "suppressed": report.suppressed,
+        "rules": {
+            rule: dict(st) for rule, st in sorted(report.rule_stats.items())
+        },
         "files": report.files,
         "errors": report.errors,
         "duration_s": round(report.duration_s, 3),

@@ -35,7 +35,7 @@ reason.
 from __future__ import annotations
 
 import ast
-from typing import Dict, Iterable, List, Optional, Tuple
+from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 from edl_tpu.analysis.core import Finding, ModuleCtx, Rule, register
 from edl_tpu.analysis.rules._util import (
@@ -47,6 +47,60 @@ from edl_tpu.analysis.rules._util import (
 )
 
 _TaintKey = Tuple[str, str]  # ("n", name) | ("a", self-attr)
+
+
+def _donating_params(
+    fn: ast.FunctionDef,
+    jitted: Dict[str, Tuple[int, ...]],
+    attrs: Dict[str, Tuple[int, ...]],
+    offset: int,
+) -> Tuple[int, ...]:
+    """One-level call summary: which of ``fn``'s positional arguments
+    (caller-side indices, ``offset``=1 drops ``self``) are passed
+    straight to a donate position of a known jitted call in its body —
+    so ``a, b = helper(buf)`` taints ``buf`` in the caller even though
+    the ``jax.jit`` call is one frame down.
+
+    Conservative on purpose: a parameter rebound anywhere in the body
+    is excluded (the donated value may no longer be the caller's), and
+    ``*args`` splats / keyword passing are ignored."""
+    params = [a.arg for a in fn.args.args]
+    rebound: Set[str] = set()
+    for n in ast.walk(fn):
+        if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            tgts = n.targets if isinstance(n, ast.Assign) else [n.target]
+            for t in tgts:
+                for sub in ast.walk(t):
+                    if isinstance(sub, ast.Name) and isinstance(
+                        sub.ctx, ast.Store
+                    ):
+                        rebound.add(sub.id)
+    donated: Set[int] = set()
+    for n in ast.walk(fn):
+        if not isinstance(n, ast.Call):
+            continue
+        nums: Optional[Tuple[int, ...]] = None
+        f = n.func
+        if isinstance(f, ast.Name):
+            nums = jitted.get(f.id)
+        else:
+            a = self_attr(f)
+            if a is not None:
+                nums = attrs.get(a)
+        if not nums or any(isinstance(a, ast.Starred) for a in n.args):
+            continue
+        for i in nums:
+            if i >= len(n.args):
+                continue
+            arg = n.args[i]
+            base = arg.value if isinstance(arg, ast.Subscript) else arg
+            if (
+                isinstance(base, ast.Name)
+                and base.id in params
+                and base.id not in rebound
+            ):
+                donated.add(params.index(base.id) - offset)
+    return tuple(sorted(i for i in donated if i >= 0))
 
 
 class _Taint:
@@ -99,19 +153,48 @@ def _module_donation_maps(tree: ast.Module):
                     attrs[a] = nums
         if attrs:
             attr_donate[cls.name] = attrs
-    return jitted, factories, attr_donate
+
+    # one-level helper summaries: `def split(buf): a, b = step(buf); ...`
+    # donates its caller's argument even though the jit call is inside
+    helper_fns: Dict[str, Tuple[int, ...]] = {}
+    for node in tree.body:
+        if isinstance(node, ast.FunctionDef) and node.name not in jitted:
+            nums = _donating_params(node, jitted, {}, offset=0)
+            if nums:
+                helper_fns[node.name] = nums
+    helper_methods: Dict[str, Dict[str, Tuple[int, ...]]] = {}
+    for cls in tree.body:
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        attrs = attr_donate.get(cls.name, {})
+        meths: Dict[str, Tuple[int, ...]] = {}
+        for m in cls.body:
+            if isinstance(m, ast.FunctionDef) and m.name not in jitted:
+                nums = _donating_params(m, jitted, attrs, offset=1)
+                if nums:
+                    meths[m.name] = nums
+        if meths:
+            helper_methods[cls.name] = meths
+    return jitted, factories, attr_donate, helper_fns, helper_methods
 
 
 class _FnFlow:
     """Abstract interpretation of one function body: taint = donated,
     Load of tainted = finding, rebind = kill."""
 
-    def __init__(self, rule_id, ctx, jitted, factories, attrs):
+    def __init__(
+        self, rule_id, ctx, jitted, factories, attrs,
+        helper_fns=None, helper_methods=None,
+    ):
         self.rule_id = rule_id
         self.ctx = ctx
         self.jitted = dict(jitted)  # name -> argnums (grows with locals)
         self.factories = factories
         self.attrs = attrs  # self attr -> argnums
+        # one-level interprocedural summaries (helper name -> caller-
+        # side donated arg indices); see _donating_params
+        self.helper_fns = helper_fns or {}
+        self.helper_methods = helper_methods or {}
         self.taint: Dict[_TaintKey, _Taint] = {}
         self.findings: List[Finding] = []
         self._seen = set()
@@ -171,10 +254,15 @@ class _FnFlow:
         if isinstance(f, ast.Name):
             if f.id in self.jitted:
                 return self.jitted[f.id], f.id
+            if f.id in self.helper_fns:
+                return self.helper_fns[f.id], f.id
             return None, ""
         a = self_attr(f)
-        if a is not None and a in self.attrs:
-            return self.attrs[a], f"self.{a}"
+        if a is not None:
+            if a in self.attrs:
+                return self.attrs[a], f"self.{a}"
+            if a in self.helper_methods:
+                return self.helper_methods[a], f"self.{a}"
         return None, ""
 
     def _eval_call(self, call: ast.Call) -> None:
@@ -333,28 +421,33 @@ class DonationSafetyRule(Rule):
     )
 
     def check_module(self, ctx: ModuleCtx) -> Iterable[Finding]:
-        jitted, factories, attr_donate = _module_donation_maps(ctx.tree)
+        (
+            jitted, factories, attr_donate, helper_fns, helper_methods,
+        ) = _module_donation_maps(ctx.tree)
         findings: List[Finding] = []
 
-        def analyze(fn: ast.FunctionDef, attrs) -> None:
-            flow = _FnFlow(self.id, ctx, jitted, factories, attrs)
+        def analyze(fn: ast.FunctionDef, attrs, meths) -> None:
+            flow = _FnFlow(
+                self.id, ctx, jitted, factories, attrs, helper_fns, meths
+            )
             flow.exec_body(fn.body)
             findings.extend(flow.findings)
 
         for node in ctx.tree.body:
             if isinstance(node, ast.FunctionDef):
-                analyze(node, {})
+                analyze(node, {}, {})
                 for sub in ast.walk(node):
                     if isinstance(sub, ast.FunctionDef) and sub is not node:
-                        analyze(sub, {})
+                        analyze(sub, {}, {})
             elif isinstance(node, ast.ClassDef):
                 attrs = attr_donate.get(node.name, {})
+                meths = helper_methods.get(node.name, {})
                 for m in node.body:
                     if isinstance(m, ast.FunctionDef):
-                        analyze(m, attrs)
+                        analyze(m, attrs, meths)
                         for sub in ast.walk(m):
                             if isinstance(sub, ast.FunctionDef) and sub is not m:
-                                analyze(sub, attrs)
+                                analyze(sub, attrs, meths)
         return findings
 
 

@@ -84,6 +84,9 @@ class _Unit:
     is_entry: bool = False
     calls: Set[str] = field(default_factory=set)  # self-method names
     accesses: List[_Access] = field(default_factory=list)
+    # (callee method name, locks held at the call site) — drives the
+    # one-level interprocedural context expansion in _check_class
+    call_sites: List[Tuple[str, FrozenSet[str]]] = field(default_factory=list)
 
 
 def _lock_attrs(cls: ast.ClassDef) -> Set[str]:
@@ -133,14 +136,24 @@ class _UnitWalker:
     """Collect self-attr accesses (with held locks) and self-calls in
     one unit body, without descending into nested defs."""
 
-    def __init__(self, unit: _Unit, locks: Set[str], method_names: Set[str]):
+    def __init__(
+        self,
+        unit: _Unit,
+        locks: Set[str],
+        method_names: Set[str],
+        def_locks: Optional[Dict[int, Tuple[str, ...]]] = None,
+        inherited: Tuple[str, ...] = (),
+    ):
         self.u = unit
         self.locks = locks
         self.methods = method_names
-        self.held: Tuple[str, ...] = ()
+        # shared across the class's walkers: id(def node) -> locks held
+        # at the def site, so nested units can inherit them
+        self.def_locks = def_locks if def_locks is not None else {}
+        self.held: Tuple[str, ...] = tuple(inherited)
         if unit.name.rsplit(".", 1)[-1].endswith("_locked"):
             # convention: *_locked methods run with the lock held
-            self.held = ("<caller-held>",)
+            self.held = self.held + ("<caller-held>",)
 
     def _record(self, attr: str, node: ast.AST, write: bool) -> None:
         if attr in self.locks:
@@ -175,8 +188,31 @@ class _UnitWalker:
             self.walk_body(stmt.body)
             self.held = prev
             return
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            # bare self.X.acquire()/release() statements (the
+            # acquire-try/finally-release idiom, and RLock re-entry
+            # outside a `with`) adjust the lockset linearly: the try
+            # body is walked before the finally that releases, so the
+            # guarded region comes out right
+            f = stmt.value.func
+            if isinstance(f, ast.Attribute) and f.attr in ("acquire", "release"):
+                base = self_attr(f.value)
+                if base is not None and base in self.locks:
+                    if f.attr == "acquire":
+                        self.held = self.held + (base,)
+                    elif base in self.held:
+                        i = len(self.held) - 1 - self.held[::-1].index(base)
+                        self.held = self.held[:i] + self.held[i + 1:]
+                    for a in stmt.value.args:
+                        self.walk_expr(a)
+                    return
         if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
-            return  # nested units are walked separately
+            # nested units are walked separately; remember the lockset
+            # at the def site so a closure created under `with self._L`
+            # is analyzed as running under it (Thread targets excepted —
+            # the new thread starts with nothing held)
+            self.def_locks[id(stmt)] = self.held
+            return
         if isinstance(stmt, ast.Assign):
             self.walk_expr(stmt.value)
             for t in stmt.targets:
@@ -250,6 +286,9 @@ class _UnitWalker:
                     # self.method(...): a call edge, not a data access
                     if f.attr in self.methods:
                         self.u.calls.add(f.attr)
+                        self.u.call_sites.append(
+                            (f.attr, frozenset(self.held))
+                        )
                     else:
                         self._record(f.attr, f, write=False)
                 else:
@@ -328,8 +367,17 @@ class LocksetRaceRule(Rule):
         if not spawns_thread and not locks:
             return []
 
-        for u in units.values():
-            _UnitWalker(u, locks, method_names).walk_body(u.node.body)
+        # methods first, nested defs after (stable sort keeps AST
+        # pre-order within each group, so a nested def's lockset is
+        # recorded before its own nested defs are walked)
+        def_locks: Dict[int, Tuple[str, ...]] = {}
+        for u in sorted(units.values(), key=lambda x: x.name.count(".")):
+            inherited: Tuple[str, ...] = ()
+            if "." in u.name and u.name not in entries:
+                inherited = def_locks.get(id(u.node), ())
+            _UnitWalker(u, locks, method_names, def_locks, inherited).walk_body(
+                u.node.body
+            )
 
         thread_units = _closure(entries, units)
         main_seeds = {
@@ -341,11 +389,46 @@ class LocksetRaceRule(Rule):
         }
         main_units = _closure(main_seeds, units)
 
-        # group accesses by attribute
+        # one-level interprocedural context: which locksets do callers
+        # hold at each self.method() site?
+        call_ctxs: Dict[str, Set[FrozenSet[str]]] = {}
+        for u in units.values():
+            for callee, held in u.call_sites:
+                call_ctxs.setdefault(callee, set()).add(held)
+
+        # group accesses by attribute, expanding each unit's accesses
+        # over its calling contexts: a private helper invoked only
+        # under `with self._lock` inherits that lock; public methods,
+        # thread entries, nested closures, and methods with no visible
+        # callers keep a bare (empty) context because an outside caller
+        # can invoke them with nothing held
         by_attr: Dict[str, List[_Access]] = {}
         for u in units.values():
+            ctxs: List[FrozenSet[str]] = []
+            bare = (
+                "." in u.name  # nested: def-site locks already applied
+                or u.name in entries
+                or not u.name.startswith("_")
+                or u.name not in call_ctxs
+            )
+            if bare:
+                ctxs.append(frozenset())
+            for c in sorted(call_ctxs.get(u.name, ()), key=sorted):
+                if c not in ctxs:
+                    ctxs.append(c)
+            seen: Set[Tuple] = set()
             for a in u.accesses:
-                by_attr.setdefault(a.attr, []).append(a)
+                for c in ctxs:
+                    lks = (a.locks | c) if c else a.locks
+                    ident = (a.attr, a.line, a.col, a.write, lks)
+                    if ident in seen:
+                        continue
+                    seen.add(ident)
+                    exp = a if lks == a.locks else _Access(
+                        attr=a.attr, unit=a.unit, line=a.line, col=a.col,
+                        write=a.write, locks=lks, in_init=a.in_init,
+                    )
+                    by_attr.setdefault(a.attr, []).append(exp)
 
         findings: List[Finding] = []
         for attr, accesses in sorted(by_attr.items()):

@@ -356,15 +356,15 @@ class CoordinatorClient:
         self._lock = threading.Lock()
         self._sock = None
         self._file = None
-        self._connect()
+        self._connect_locked()
 
-    def _connect(self) -> None:
+    def _connect_locked(self) -> None:
         self._sock = socket.create_connection(
             (self._host, self._port), timeout=self._timeout_s
         )
         self._file = self._sock.makefile("rwb")
 
-    def close(self) -> None:
+    def _close_locked(self) -> None:
         try:
             if self._file is not None:
                 self._file.close()
@@ -374,9 +374,16 @@ class CoordinatorClient:
             pass
         self._sock = self._file = None
 
-    def _roundtrip(self, line: str) -> str:
+    def close(self) -> None:
+        # public close must take the lock: _call holds it across a full
+        # round trip, and tearing the socket down under an in-flight
+        # RPC is exactly the _Conn.close race PR 7 fixed in shard_server
+        with self._lock:
+            self._close_locked()
+
+    def _roundtrip_locked(self, line: str) -> str:
         if self._sock is None:
-            self._connect()
+            self._connect_locked()
         self._file.write(line.encode() + b"\n")
         self._file.flush()
         resp = self._file.readline()
@@ -404,13 +411,13 @@ class CoordinatorClient:
                         with tracing.span(
                             "coord.rpc", op=line.split(" ", 1)[0]
                         ):
-                            out = self._roundtrip(line)
+                            out = self._roundtrip_locked(line)
                     else:
-                        out = self._roundtrip(line)
+                        out = self._roundtrip_locked(line)
                     rpcs.inc(op=line.split(" ", 1)[0])
                     return out
                 except (ConnectionError, OSError, socket.timeout) as e:
-                    self.close()
+                    self._close_locked()
                     reconnects.inc()
                     _emit_rpc_error(line.split(" ", 1)[0], e)
                     if time.monotonic() >= deadline:

@@ -24,9 +24,26 @@ t0=$(date +%s)
 echo "== phase 0: edl check (project-invariant static analysis) =="
 # runs FIRST: a donation-safety / lockset / telemetry violation fails
 # the suite before anything compiles. Baseline covers the triaged
-# deliberate findings; anything NEW fails here.
-python -m edl_tpu.cli check --baseline analysis_baseline.json
+# deliberate findings; anything NEW fails here. The JSON per-rule
+# block goes to the gate log so a creeping suppression/baseline count
+# is visible in CI output, not just in the repo diff.
+CKJSON="${TMPDIR:-/tmp}/edl-check.$$.json"
+python -m edl_tpu.cli check --baseline analysis_baseline.json --json \
+    > "$CKJSON"
 rc0=$?
+python - "$CKJSON" <<'PY'
+import json, sys
+r = json.load(open(sys.argv[1]))
+print(f"edl check: {len(r['findings'])} findings, "
+      f"{len(r['baselined'])} baselined, {r['suppressed']} suppressed "
+      f"in {r['files']} files [{r['duration_s']}s]")
+for rule, st in sorted(r.get("rules", {}).items()):
+    print(f"  {rule:<24} findings={st['findings']} "
+          f"baselined={st['baselined']} suppressed={st['suppressed']}")
+for f in r["findings"]:
+    print(f"  NEW: {f['path']}:{f['line']}: [{f['rule']}] {f['message']}")
+PY
+rm -f "$CKJSON"
 tA=$(date +%s)
 echo "== phase 0 done in $((tA - t0))s (rc=$rc0) =="
 
@@ -177,6 +194,18 @@ fi
 rm -rf "$EVDIR"
 t9=$(date +%s)
 echo "== phase 9 done in $((t9 - t8))s (rc=$rc9) =="
-echo "== total $((t9 - t0))s =="
 
-[ "$rc0" -eq 0 ] && [ "$rc1" -eq 0 ] && [ "$rc2" -eq 0 ] && [ "$rc3" -eq 0 ] && [ "$rc4" -eq 0 ] && [ "$rc5" -eq 0 ] && [ "$rc6" -eq 0 ] && [ "$rc7" -eq 0 ] && [ "$rc8" -eq 0 ] && [ "$rc9" -eq 0 ]
+echo "== phase 10: edl schedcheck (deterministic interleaving explorer) =="
+# the dynamic twin of phase 0: every subsystem harness explored under
+# the seeded scheduler with the happens-before detector on. Clean
+# harnesses must stay race-free, the mutation corpus must reproduce
+# the three PR 7 races (each with a printed repro seed + minimal
+# schedule), and no CONFIRMED static site may REGRESS. Hard 60 s wall
+# cap — the whole sweep runs in a few seconds on an idle box.
+timeout -k 10 60 python -m edl_tpu.cli schedcheck --budget 24 --seed 0
+rc10=$?
+t10=$(date +%s)
+echo "== phase 10 done in $((t10 - t9))s (rc=$rc10) =="
+echo "== total $((t10 - t0))s =="
+
+[ "$rc0" -eq 0 ] && [ "$rc1" -eq 0 ] && [ "$rc2" -eq 0 ] && [ "$rc3" -eq 0 ] && [ "$rc4" -eq 0 ] && [ "$rc5" -eq 0 ] && [ "$rc6" -eq 0 ] && [ "$rc7" -eq 0 ] && [ "$rc8" -eq 0 ] && [ "$rc9" -eq 0 ] && [ "$rc10" -eq 0 ]

@@ -110,6 +110,65 @@ def test_donation_suppression(tmp_path):
     assert rep.suppressed == 1
 
 
+def test_donation_tuple_unpack_through_helper(tmp_path):
+    """One-level call summary: `a, b = split(buf)` donates buf even
+    though the jit call is inside the helper (the satellite-task false
+    negative — previously invisible to the per-function dataflow)."""
+    rep = run_on(tmp_path, DONATED_DEF + """
+    def split(buf, x):
+        a = step(buf, x)
+        return a, x
+
+    def use(buf, x):
+        a, b = split(buf, x)
+        return float(buf.sum())  # stale: buf was donated inside split
+    """, rules=["donation-safety"])
+    assert rules_of(rep) == ["donation-safety"]
+    assert "'buf' is read after being donated to split" in rep.findings[0].message
+
+
+def test_donation_helper_negatives_are_clean(tmp_path):
+    """No summary for a helper that doesn't donate, or that rebinds
+    the parameter before the donating call (the donated value is the
+    callee's own, not the caller's)."""
+    rep = run_on(tmp_path, DONATED_DEF + """
+    def noop(buf, x):
+        return buf + x  # no donation inside
+
+    def shield(buf, x):
+        buf = buf + 0.0  # rebound: callee donates its own copy
+        return step(buf, x)
+
+    def use(buf, x):
+        y = noop(buf, x)
+        z = shield(buf, x)
+        return float(buf.sum())
+    """, rules=["donation-safety"])
+    assert rep.findings == []
+
+
+def test_donation_helper_method_level(tmp_path):
+    """`self._advance(state)` donates through one method-call level;
+    rebinding from the helper's result stays the blessed pattern."""
+    rep = run_on(tmp_path, DONATED_DEF + """
+    class Engine:
+        def _advance(self, state, x):
+            return step(state, x)
+
+        def run(self, state, xs):
+            for x in xs:
+                state = self._advance(state, x)  # rebound: clean
+            return state
+
+        def bad(self, state, x):
+            out = self._advance(state, x)
+            return float(state.sum())  # stale read through the helper
+    """, rules=["donation-safety"])
+    assert rules_of(rep) == ["donation-safety"]
+    f = rep.findings[0]
+    assert "'state' is read after being donated to self._advance" in f.message
+
+
 # ---------------------------------------------------------------------------
 # lockset-race
 
@@ -216,6 +275,144 @@ def test_lockset_init_and_readonly_are_exempt(tmp_path):
             return self._cfg["a"]  # read-only after init: safe
     """, rules=["lockset-race"])
     assert rep.findings == []
+
+
+def test_lockset_acquire_release_statements_guard(tmp_path):
+    """Bare self._lock.acquire()/try/finally-release() counts as a
+    guarded region, same as `with self._lock` (previously invisible:
+    the accesses in between looked unguarded and produced a spurious
+    mixed-lockset finding)."""
+    rep = run_on(tmp_path, """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.n = 0
+            threading.Thread(target=self._run, daemon=True).start()
+
+        def _run(self):
+            self._lock.acquire()
+            try:
+                self.n += 1
+            finally:
+                self._lock.release()
+
+        def bump(self):
+            with self._lock:
+                self.n += 1
+    """, rules=["lockset-race"])
+    assert rep.findings == []
+
+
+def test_lockset_rlock_reentrant_nested_helper_is_clean(tmp_path):
+    """The satellite-task fixture: a nested helper defined under the
+    RLock runs under it (def-site lockset inheritance) — re-entry in
+    the helper is NOT a fresh unguarded access."""
+    rep = run_on(tmp_path, """
+    import threading
+
+    class Ledger:
+        def __init__(self):
+            self._lock = threading.RLock()
+            self.total = 0
+            threading.Thread(target=self.loop, daemon=True).start()
+
+        def loop(self):
+            with self._lock:
+                def add(v):
+                    self.total += v  # runs under the outer RLock
+                add(1)
+                self._lock.acquire()  # re-entrant acquire, same lock
+                try:
+                    add(2)
+                finally:
+                    self._lock.release()
+
+        def read(self):
+            with self._lock:
+                return self.total
+    """, rules=["lockset-race"])
+    assert rep.findings == []
+
+
+def test_lockset_nested_thread_target_does_not_inherit(tmp_path):
+    """The counterweight to def-site inheritance: a nested def handed
+    to Thread(target=...) runs in the NEW thread, where nothing is
+    held — it must stay unguarded and flag."""
+    rep = run_on(tmp_path, """
+    import threading
+
+    class Spawner:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.n = 0
+
+        def start(self):
+            with self._lock:
+                def work():
+                    self.n += 1  # new thread: the lock is NOT held
+                threading.Thread(target=work, daemon=True).start()
+
+        def read(self):
+            with self._lock:
+                return self.n
+    """, rules=["lockset-race"])
+    assert rules_of(rep) == ["lockset-race"]
+    assert "Spawner.n" in rep.findings[0].message
+
+
+def test_lockset_private_helper_inherits_caller_lock(tmp_path):
+    """One-level interprocedural context: a private helper invoked
+    only under the lock is guarded; add one bare caller and the race
+    is visible again."""
+    clean = run_on(tmp_path, """
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.n = 0
+            threading.Thread(target=self.loop, daemon=True).start()
+
+        def _bump(self):
+            self.n += 1  # only ever called under the lock
+
+        def loop(self):
+            with self._lock:
+                self._bump()
+
+        def read(self):
+            with self._lock:
+                return self.n
+    """, rules=["lockset-race"])
+    assert clean.findings == []
+
+    mixed = run_on(tmp_path, """
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.n = 0
+            threading.Thread(target=self.loop, daemon=True).start()
+
+        def _bump(self):
+            self.n += 1
+
+        def loop(self):
+            with self._lock:
+                self._bump()
+
+        def poke(self):
+            self._bump()  # bare public caller: the race is back
+
+        def read(self):
+            with self._lock:
+                return self.n
+    """, rules=["lockset-race"], name="mixed.py")
+    assert rules_of(mixed) == ["lockset-race"]
+    assert "Counter.n" in mixed.findings[0].message
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,321 @@
+"""`edl schedcheck` dynamic verification (edl_tpu/analysis/sched.py +
+hb.py + harnesses.py): sync-shim fidelity (on-semantics == stdlib,
+off == byte-for-byte stdlib objects), deterministic seeded exploration
+with exact replay, the vector-clock happens-before detector, deadlock
+detection, the PR 7 mutation regression corpus, and the CLI verb.
+jax-free — the whole checker is pure stdlib threading."""
+
+import json
+import queue
+import threading
+import time
+
+import pytest
+
+from edl_tpu.analysis import harnesses as H
+from edl_tpu.analysis import hb, sched
+from edl_tpu.cli.main import main as cli_main
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _quiet_logs():
+    """Harnesses drive real error paths (pusher publish failures, conn
+    teardown) whose warn/error logs are noise here — evidence is
+    reported through the explorer."""
+    import logging
+
+    prev = logging.root.manager.disable
+    logging.disable(logging.ERROR)
+    H.warm_globals()  # singletons built with REAL locks, pre-shim
+    yield
+    logging.disable(prev)
+
+
+# ---------------------------------------------------------------------------
+# shim fidelity (satellite: shim-on == stdlib semantics, shim-off == stdlib)
+
+
+def _stdlib_identity_ok():
+    return (
+        threading.Lock is sched._REAL["Lock"]
+        and threading.RLock is sched._REAL["RLock"]
+        and threading.Condition is sched._REAL["Condition"]
+        and threading.Event is sched._REAL["Event"]
+        and threading.Thread is sched._REAL["Thread"]
+        and queue.Queue is sched._REAL["Queue"]
+        and time.sleep is sched._REAL["sleep"]
+    )
+
+
+def test_shim_off_is_byte_for_byte_stdlib():
+    """Zero overhead when not checking: with no scheduler active, the
+    names in threading/queue/time are the very same objects captured
+    at import — not wrappers."""
+    assert _stdlib_identity_ok()
+
+
+def _counter_harness(sink):
+    """Lock-free shared counter: two shim threads, interleaved bumps.
+    Python-level += on a dict slot is one uninstrumented op, so every
+    schedule must agree with the plain stdlib run."""
+
+    def h():
+        state = {"n": 0}
+
+        def worker():
+            for _ in range(5):
+                state["n"] += 1
+
+        ts = [threading.Thread(target=worker) for _ in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        sink.append(state["n"])
+
+    return h
+
+
+def test_shim_on_semantics_match_stdlib_over_50_seeds():
+    ref_sink = []
+    _counter_harness(ref_sink)()  # plain stdlib run, no scheduler
+    assert ref_sink == [10]
+
+    got = []
+    h = _counter_harness(got)
+    for k in range(50):
+        res = sched.run_one(h, seed=k)
+        assert res.failure is None, res.failure
+    assert got == [10] * 50
+
+    # and the shim tore down cleanly every time
+    assert _stdlib_identity_ok()
+
+
+# ---------------------------------------------------------------------------
+# determinism, replay, exploration
+
+
+def _racy_harness():
+    class Obj:
+        pass
+
+    o = Obj()
+    o.x = 0
+    sched.instrument(o, ["x"], "O")
+
+    def w():
+        o.x = o.x + 1
+
+    ts = [threading.Thread(target=w, name=f"w{i}") for i in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+
+
+def test_same_seed_same_schedule_and_exact_replay():
+    r1 = sched.run_one(_racy_harness, seed=3)
+    r2 = sched.run_one(_racy_harness, seed=3)
+    assert r1.choices == r2.choices
+    ops1 = [(t.task, t.op, t.obj) for t in r1.trace]
+    ops2 = [(t.task, t.op, t.obj) for t in r2.trace]
+    assert ops1 == ops2
+
+    rep = sched.replay(_racy_harness, r1.choices, r1.seed)
+    assert not rep.diverged
+    assert [(t.task, t.op, t.obj) for t in rep.trace] == ops1
+    assert rep.race_keys == r1.race_keys
+
+
+def test_explore_finds_lost_update_race_and_minimizes():
+    res = sched.explore(_racy_harness, "racy", schedules=16, seed=0)
+    assert any("O.x" in r["var"] for r in res.races)
+    for r in res.races:
+        assert isinstance(r["seed"], int)  # printed repro seed
+        assert r["minimal_schedule"], "evidence must carry a schedule"
+        # the window ends at the racing access and stays printable
+        assert len(r["minimal_schedule"]) <= 30
+
+
+def test_locked_counter_is_race_free():
+    def h():
+        class Obj:
+            pass
+
+        o = Obj()
+        o.x = 0
+        lk = threading.Lock()
+        sched.instrument(o, ["x"], "L")
+
+        def w():
+            with lk:
+                o.x = o.x + 1
+
+        ts = [threading.Thread(target=w) for _ in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert o.x == 2
+
+    res = sched.explore(h, "locked", schedules=12, seed=0)
+    assert res.races == [] and res.failure is None
+
+
+def test_abba_deadlock_is_detected():
+    def h():
+        l1 = threading.Lock()
+        l2 = threading.Lock()
+
+        def a():
+            with l1:
+                with l2:
+                    pass
+
+        def b():
+            with l2:
+                with l1:
+                    pass
+
+        ts = [
+            threading.Thread(target=a, name="a"),
+            threading.Thread(target=b, name="b"),
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+
+    res = sched.explore(h, "abba", schedules=24, seed=0)
+    assert res.failure is not None
+    assert res.failure["kind"] == "deadlock"
+    assert res.failure["minimal_schedule"]
+
+
+# ---------------------------------------------------------------------------
+# happens-before detector (pure unit — no shim)
+
+
+def test_hb_channel_orders_and_unordered_races():
+    st = hb.HBState()
+    assert st.access("a", "v", True, "f:1") is None
+    st.release("a", "ch")
+    st.acquire("b", "ch")
+    assert st.access("b", "v", True, "f:2") is None  # ordered via ch
+    r = st.access("c", "v", True, "f:3")  # c never synchronized
+    assert r is not None and r.var == "v"
+    # dedup: the same site pair reports once
+    assert st.access("c", "v", True, "f:3") is None
+
+
+def test_hb_fork_join_edges():
+    st = hb.HBState()
+    st.access("parent", "v", True, "f:1")
+    st.fork("parent", "child")
+    assert st.access("child", "v", True, "f:2") is None  # after fork
+    st.join("parent", "child")
+    assert st.access("parent", "v", False, "f:3") is None  # after join
+
+
+# ---------------------------------------------------------------------------
+# mutation regression corpus (the three PR 7 fixed races)
+
+
+@pytest.mark.parametrize(
+    "name",
+    ["mut-pusher-backoff", "mut-controller-updaters", "mut-conn-close"],
+)
+def test_mutation_corpus_reproduces_deterministically(name):
+    h = H.HARNESSES[name]
+    r1 = sched.explore(
+        h.fn, name, schedules=h.schedules, seed=0, max_ops=h.max_ops
+    )
+    assert r1.evidence, f"{name} found no evidence"
+    for key in h.expect_keys:
+        assert H._evidence_matches(r1, key), f"{name}: no evidence for {key}"
+    for race in r1.races:
+        assert isinstance(race["seed"], int)
+        assert race["minimal_schedule"]
+
+    # fixed seed => identical rediscovery (repro seeds and race keys)
+    r2 = sched.explore(
+        h.fn, name, schedules=h.schedules, seed=0, max_ops=h.max_ops
+    )
+    assert [r["var"] for r in r1.races] == [r["var"] for r in r2.races]
+    assert [r["seed"] for r in r1.races] == [r["seed"] for r in r2.races]
+
+
+def test_guarded_counterparts_stay_clean():
+    for name in ("pusher-backoff", "controller-updaters", "conn-close"):
+        h = H.HARNESSES[name]
+        res = sched.explore(h.fn, name, schedules=8, seed=0, max_ops=h.max_ops)
+        assert not res.evidence, f"{name}: {res.races or res.failure}"
+
+
+def test_verdicts_confirm_static_sites():
+    results = {}
+    for name in (
+        "pusher-backoff", "mut-pusher-backoff",
+        "controller-updaters", "mut-controller-updaters",
+    ):
+        h = H.HARNESSES[name]
+        results[name] = sched.explore(
+            h.fn, name, schedules=h.schedules, seed=0, max_ops=h.max_ops
+        )
+    vs = {v["site"]: v["verdict"] for v in H.verdicts(results)}
+    assert (
+        vs["edl_tpu/obs/fleet.py:MetricsPusher._fail_streak"] == "CONFIRMED"
+    )
+    assert (
+        vs["edl_tpu/controller/controller.py:Controller.updaters"]
+        == "CONFIRMED"
+    )
+
+
+# ---------------------------------------------------------------------------
+# CLI verb
+
+
+def test_cli_schedcheck_json_traces_and_exit_codes(tmp_path, capsys):
+    rc = cli_main([
+        "schedcheck", "pusher-backoff", "mut-pusher-backoff",
+        "--json", "--trace-dir", str(tmp_path / "tr"),
+    ])
+    out = capsys.readouterr().out
+    doc = json.loads(out)
+    assert rc == 0 and doc["ok"]
+    assert {h["harness"] for h in doc["harnesses"]} == {
+        "pusher-backoff", "mut-pusher-backoff"
+    }
+    verdicts = {v["site"]: v["verdict"] for v in doc["verdicts"]}
+    assert (
+        verdicts["edl_tpu/obs/fleet.py:MetricsPusher._fail_streak"]
+        == "CONFIRMED"
+    )
+    assert (tmp_path / "tr" / "mut-pusher-backoff.jsonl").exists()
+    lines = [
+        json.loads(ln)
+        for ln in (tmp_path / "tr" / "mut-pusher-backoff.jsonl")
+        .read_text()
+        .splitlines()
+    ]
+    assert lines[0]["type"] == "summary"
+    assert any(ln["type"] == "race" for ln in lines)
+
+    rc = cli_main(["schedcheck", "--list"])
+    capsys.readouterr()
+    assert rc == 0
+
+    rc = cli_main(["schedcheck", "no-such-harness"])
+    capsys.readouterr()
+    assert rc == 2
+
+
+def test_cli_schedcheck_text_prints_minimal_schedule(capsys):
+    rc = cli_main(["schedcheck", "mut-controller-updaters", "--seed", "0"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "minimal schedule" in out
+    assert "repro: seed" in out
+    assert "Controller.updaters" in out
