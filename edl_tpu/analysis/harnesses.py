@@ -389,6 +389,68 @@ def _serving_admission() -> None:
     assert alloc.free_blocks == 7, alloc.free_blocks  # block 0 is scratch
 
 
+def _router_table(mutate: bool) -> None:
+    import threading
+
+    from edl_tpu.obs.metrics import MetricsRegistry
+    from edl_tpu.serving import router as rt
+
+    table = rt.ReplicaTable(
+        registry=MetricsRegistry(), suspect_after=1, dead_after=2
+    )
+    for rid in ("a", "b", "c"):
+        table.add(rid, f"http://{rid}")
+        table.set_state(rid, rt.READY)
+    if mutate:
+        table._lock = NullLock()
+    table._replicas = TrackedDict(
+        "ReplicaTable._replicas", table._replicas
+    )
+
+    # the three parties that share the table in production: the
+    # supervisor's health prober, the router's acquire/release hot
+    # path, and the supervisor's drain→evict sequence
+    def prober() -> None:
+        for ok in (False, True, False, False):
+            table.mark_probe("a", ok, queue_depth=1)
+            checkpoint("probe-gap")
+
+    def route() -> None:
+        for _ in range(3):
+            ref = table.acquire(session="s", prefix_key="71,12")
+            checkpoint("route-gap")
+            if ref is not None:
+                table.release(ref.id)
+
+    def evict() -> None:
+        table.set_state("b", rt.DRAINING)
+        checkpoint("evict-gap")
+        table.remove("b")
+
+    t1 = threading.Thread(target=prober, name="probe")
+    t2 = threading.Thread(target=route, name="route")
+    t3 = threading.Thread(target=evict, name="evict")
+    t1.start()
+    t2.start()
+    t3.start()
+    t1.join()
+    t2.join()
+    t3.join()
+    # a's probe verdicts are False,True,False,False with
+    # suspect_after=1 dead_after=2: the final two failures walk
+    # READY → SUSPECT → DEAD regardless of interleaving (DEAD sticky)
+    rep_a = table.get("a")
+    assert rep_a is not None and rep_a.state == rt.DEAD, rep_a
+    assert table.get("b") is None, "evicted replica still tabled"
+    rep_c = table.get("c")
+    assert rep_c is not None and rep_c.state == rt.READY, rep_c
+    # every acquire was released: no leaked inflight count survives
+    for rep in table.snapshot():
+        assert rep.inflight == 0, (rep.id, rep.inflight)
+    # remove() purges the session pin when it pointed at the victim
+    assert table._sessions.get("s") != "b", table._sessions
+
+
 def _flight_recorder() -> None:
     import threading
 
@@ -495,6 +557,9 @@ HARNESSES: Dict[str, Harness] = {
         _mk("serving-admission", lambda: _serving_admission(),
             "serving admission vs drain: slot table + block refcounts, "
             "no leak and no double free"),
+        _mk("router-table", lambda: _router_table(False),
+            "fleet ReplicaTable: prober vs route vs evict under _lock "
+            "(expect race-free; state machine + inflight invariants)"),
         _mk("flight-recorder", lambda: _flight_recorder(),
             "FlightRecorder ring: seq/dropped/counts invariants under "
             "two emitters and a reader"),
@@ -517,6 +582,14 @@ HARNESSES: Dict[str, Harness] = {
             "(AttributeError crash or file/sock HB race)",
             expect_evidence=True, expect_keys=["_Conn.file", "_Conn.sock",
                                                "died"],
+            mutation=True),
+        _mk("mut-router-table", lambda: _router_table(True),
+            "MUTATION: ReplicaTable._lock removed — prober/route/evict "
+            "race on the shared replica map",
+            expect_evidence=True,
+            # unlike mut-conn-close the lockless map rarely CRASHES —
+            # the HB race report on the shared dict is the evidence
+            expect_keys=["ReplicaTable._replicas"],
             mutation=True),
     ]
 }
@@ -546,6 +619,13 @@ STATIC_XREF: List[Dict[str, Any]] = [
                  "PR 7; conn.lock)",
         "guarded": "conn-close",
         "mutated": "mut-conn-close",
+    },
+    {
+        "site": "edl_tpu/serving/router.py:ReplicaTable._replicas",
+        "claim": "health prober, router acquire/release, and supervisor "
+                 "drain/evict share the replica map (PR 13; _lock)",
+        "guarded": "router-table",
+        "mutated": "mut-router-table",
     },
     {
         "site": "edl_tpu/cluster/kube.py:KubeJobSource._rv "

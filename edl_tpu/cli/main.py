@@ -28,7 +28,9 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
+import threading
 import time
 from typing import Optional
 
@@ -1318,6 +1320,186 @@ def run_loadgen(args) -> int:
     return 0
 
 
+def _run_fleet_replica(args) -> int:
+    """Internal replica mode: one serving engine behind the replica
+    HTTP surface, port published through ``--port-file`` so the
+    supervisor can find the ephemeral bind. This is the subprocess the
+    supervisor launches — a user never runs it by hand."""
+    import signal
+
+    from edl_tpu.serving.replica import ReplicaServer
+    from edl_tpu.serving.scheduler import RequestQueue
+
+    params = cfg = None
+    if args.dryrun:
+        import jax
+
+        from edl_tpu.models import llama
+
+        cfg = llama.LlamaConfig.tiny(vocab=args.vocab)
+        params = jax.jit(
+            lambda: llama.init_params(jax.random.PRNGKey(args.seed), cfg)
+        )()
+    else:
+        params, cfg_or_err = _load_llama_serving(args.export_dir, "", False)
+        if params is None:
+            print(cfg_or_err, file=sys.stderr)
+            return 1
+        cfg = cfg_or_err
+
+    from edl_tpu.serving.engine import ContinuousBatchingEngine
+
+    queue = RequestQueue(
+        max_total_len=args.max_len,
+        max_depth=args.max_queue,
+        max_new_cap=args.max_new_cap,
+    )
+    engine = ContinuousBatchingEngine(
+        params, cfg,
+        max_slots=args.slots,
+        max_len=args.max_len,
+        horizon=args.horizon,
+        queue=queue,
+        block_size=args.block_size,
+    )
+    srv = ReplicaServer(engine, port=args.port, generation=args.generation)
+    srv.start()
+    if args.port_file:
+        tmp = args.port_file + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({
+                "port": srv.port, "pid": os.getpid(),
+                "replica_id": args.replica_id,
+                "generation": args.generation,
+            }, f)
+        os.replace(tmp, args.port_file)  # atomic: supervisor never
+        # reads a half-written port doc
+    stop_evt = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop_evt.set())
+    signal.signal(signal.SIGINT, lambda *_: stop_evt.set())
+    print(f"# replica {args.replica_id} serving {srv.url} "
+          f"gen={args.generation}", file=sys.stderr)
+    stop_evt.wait()
+    srv.stop()
+    return 0
+
+
+def run_fleet(args) -> int:
+    """Elastic serving fleet: N engine replicas as supervised
+    subprocesses behind the fault-tolerant router (serving/fleet.py).
+    The default mode is a self-contained demo/CI lane: boot a dryrun
+    fleet, route traffic through it (optionally killing a replica or
+    rolling the weight generation mid-traffic), and report per-outcome
+    counts plus the READY floor. ``--replica`` is the internal
+    per-process entrypoint the supervisor spawns."""
+    if args.replica:
+        if args.slots < 1 or args.max_len < 2 or args.horizon < 1:
+            print("bad --slots/--max-len/--horizon", file=sys.stderr)
+            return 1
+        if not args.dryrun and not args.export_dir:
+            print("error: --replica needs --dryrun or --export-dir",
+                  file=sys.stderr)
+            return 1
+        return _run_fleet_replica(args)
+
+    # demo / CI-lane mode
+    if args.replicas < 1:
+        print(f"--replicas must be >= 1, got {args.replicas}",
+              file=sys.stderr)
+        return 1
+    if args.requests < 1:
+        print(f"--requests must be >= 1, got {args.requests}",
+              file=sys.stderr)
+        return 1
+    import random as _random
+    import shutil
+    import tempfile
+
+    from edl_tpu.serving.fleet import (
+        ReplicaSpec,
+        ReplicaSupervisor,
+        ServingFleet,
+    )
+    from edl_tpu.serving.router import (
+        HttpTransport,
+        ReplicaTable,
+        Router,
+    )
+    from edl_tpu.serving.scheduler import Request
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="edl-fleet-")
+    own_workdir = args.workdir is None
+    spec = ReplicaSpec(
+        workdir=workdir, vocab=args.vocab, slots=args.slots,
+        max_len=args.max_len, horizon=args.horizon, seed=args.seed,
+        export_dir=None if args.dryrun else args.export_dir,
+    )
+    table = ReplicaTable()
+    sup = ReplicaSupervisor(table, spec)
+    router = Router(table, transport=HttpTransport(), seed=args.seed)
+    fleet = ServingFleet(sup, router)
+
+    exporter = None
+    if args.metrics_port is not None:
+        from edl_tpu import obs
+
+        exporter = obs.start_exporter(port=args.metrics_port)
+        print(f"# metrics endpoint {exporter.url}/metrics",
+              file=sys.stderr)
+
+    rng = _random.Random(args.seed)
+    rc = 0
+    try:
+        print(f"# booting {args.replicas} replicas "
+              f"(workdir {workdir})", file=sys.stderr)
+        fleet.start(args.replicas)
+        results = {}
+        lock = threading.Lock()
+
+        def _one(i: int) -> None:
+            prompt = [rng.randrange(1, args.vocab)
+                      for _ in range(4 + i % 5)]
+            req = Request(rid=f"q{i}", prompt=prompt,
+                          max_new=args.max_new)
+            res = fleet.generate(req, session=f"s{i % 4}")
+            with lock:
+                results[req.rid] = res
+
+        threads = [threading.Thread(target=_one, args=(i,))
+                   for i in range(args.requests)]
+        for t in threads:
+            t.start()
+        if args.swap:
+            fleet.rolling_swap()
+        for t in threads:
+            t.join()
+        outcomes: dict = {}
+        for res in results.values():
+            outcomes[res.outcome] = outcomes.get(res.outcome, 0) + 1
+        report = {
+            "replicas": args.replicas,
+            "requests": args.requests,
+            "results": len(results),
+            "outcomes": outcomes,
+            "failovers": sum(r.failovers for r in results.values()),
+            "min_ready": sup.min_ready_observed,
+            "swapped": bool(args.swap),
+        }
+        ok = (len(results) == args.requests
+              and all(r.outcome in ("done", "eos")
+                      for r in results.values()))
+        report["ok"] = ok
+        print(json.dumps(report, sort_keys=True))
+        rc = 0 if ok else 1
+    finally:
+        fleet.stop()
+        if exporter is not None:
+            exporter.stop()
+        if own_workdir:
+            shutil.rmtree(workdir, ignore_errors=True)
+    return rc
+
+
 def run_profile(args) -> int:
     """Roofline report (achieved vs peak per phase + the HBM ledger +
     compile activity) from a live ``/metrics`` endpoint, a committed
@@ -2075,6 +2257,77 @@ def build_parser() -> argparse.ArgumentParser:
         "pspec rule",
     )
     pr.set_defaults(fn=run_predict)
+
+    fl = sub.add_parser(
+        "fleet",
+        help="elastic serving fleet: N supervised engine replicas "
+        "behind the fault-tolerant router — replica death fails "
+        "mid-stream requests over token-identically, scale-down "
+        "drains before evicting, weight swaps roll one replica at "
+        "a time",
+    )
+    fl.add_argument(
+        "--replicas", type=int, default=3,
+        help="fleet size for the demo mode",
+    )
+    fl.add_argument(
+        "--requests", type=int, default=12,
+        help="demo traffic: requests routed through the fleet",
+    )
+    fl.add_argument(
+        "--max-new", type=int, default=12,
+        help="token budget per demo request",
+    )
+    fl.add_argument(
+        "--swap", action="store_true",
+        help="roll the weight generation mid-traffic (one replica "
+        "at a time, READY count never below N-1)",
+    )
+    fl.add_argument(
+        "--dryrun", action="store_true",
+        help="replicas serve a tiny randomly-initialized model "
+        "(identical across replicas — the CI lane)",
+    )
+    fl.add_argument(
+        "--export-dir", default=None,
+        help="published llama export each replica serves "
+        "(alternative to --dryrun)",
+    )
+    fl.add_argument("--vocab", type=int, default=256,
+                    help="dryrun model vocab")
+    fl.add_argument("--slots", type=int, default=4,
+                    help="KV decode slots per replica")
+    fl.add_argument("--max-len", type=int, default=96,
+                    help="tokens per KV slot per replica")
+    fl.add_argument("--horizon", type=int, default=4,
+                    help="fused decode horizon per replica")
+    fl.add_argument("--max-queue", type=int, default=64,
+                    help="admission queue depth per replica")
+    fl.add_argument("--max-new-cap", type=int, default=0,
+                    help="per-request token budget cap (0 = off)")
+    fl.add_argument("--block-size", type=int, default=0,
+                    help="paged KV block size per replica (0 = off)")
+    fl.add_argument("--seed", type=int, default=1)
+    fl.add_argument(
+        "--workdir", default=None,
+        help="port files + replica logs live here (default: a "
+        "temp dir, removed on exit)",
+    )
+    fl.add_argument(
+        "--metrics-port", type=int, default=None,
+        help="expose the supervisor/router /metrics on this port "
+        "(0 = ephemeral)",
+    )
+    # internal replica mode (spawned by the supervisor)
+    fl.add_argument("--replica", action="store_true",
+                    help=argparse.SUPPRESS)
+    fl.add_argument("--replica-id", default="r?",
+                    help=argparse.SUPPRESS)
+    fl.add_argument("--port-file", default=None, help=argparse.SUPPRESS)
+    fl.add_argument("--port", type=int, default=0, help=argparse.SUPPRESS)
+    fl.add_argument("--generation", type=int, default=0,
+                    help=argparse.SUPPRESS)
+    fl.set_defaults(fn=run_fleet)
 
     return p
 

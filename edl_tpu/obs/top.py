@@ -185,6 +185,18 @@ def summarize(fams: _Fams) -> List[str]:
     workers = _total(fams, "edl_fleet_reporting_workers")
     if workers:
         lines.append(f"FLEET    reporting_workers={workers:.0f}")
+    # serving fleet strip (router + replica supervisor gauges)
+    rep_up = _total(fams, "edl_fleet_replica_up")
+    routed = _total(fams, "edl_fleet_requests_total")
+    if rep_up or routed:
+        lines.append(
+            f"FLEET    replicas_up={rep_up:.0f} "
+            f"qdepth={_total(fams, 'edl_fleet_replica_queue_depth'):.0f} "
+            f"inflight={_total(fams, 'edl_fleet_replica_inflight'):.0f} "
+            f"routed={routed:.0f} "
+            f"failovers={_total(fams, 'edl_fleet_failovers_total'):.0f} "
+            f"requeues={_total(fams, 'edl_fleet_requeues_total'):.0f}"
+        )
     chip_total = _total(fams, "edl_fleet_chip_total")
     if chip_total:
         lines.append(

@@ -35,6 +35,38 @@ DEFAULT_LOOP_SECONDS = 5.0  # reference: defaultLoopDur pkg/autoscaler.go:31
 UPDATE_RETRIES = 5  # reference: pkg/autoscaler.go:346
 
 
+class HysteresisGate:
+    """Per-key rescale damping — the cooldown machinery shared by the
+    cluster autoscaler's job-retarget loop and the serving fleet's
+    replica scaler (edl_tpu/serving/fleet.py).
+
+    Both loops have the same failure mode: a marginal signal flips the
+    decision every tick and each flip is expensive (a reshard stall for
+    training, a replica drain+spawn for serving). The gate admits an
+    action for ``key`` only when at least ``cooldown_s`` has elapsed
+    since that key's last :meth:`record`; ``cooldown_s == 0`` admits
+    everything (the undamped reference behavior). Callers may bypass
+    the gate when an urgency signal says churn is the lesser evil
+    (pending pods for training, an SLO breach for serving)."""
+
+    def __init__(self, cooldown_s: float, clock=time.monotonic):
+        if cooldown_s < 0:
+            raise ValueError(f"cooldown_s must be >= 0, got {cooldown_s}")
+        self.cooldown_s = cooldown_s
+        self.clock = clock
+        self._last: Dict[str, float] = {}
+
+    def ready(self, key: str = "") -> bool:
+        if self.cooldown_s <= 0:
+            return True
+        return (
+            self.clock() - self._last.get(key, -1e18) >= self.cooldown_s
+        )
+
+    def record(self, key: str = "") -> None:
+        self._last[key] = self.clock()
+
+
 @dataclass
 class JobState:
     """Autoscaler view of one job (reference: `job`, pkg/autoscaler.go:34-37)."""
@@ -341,7 +373,7 @@ class Autoscaler:
         # and the policy is a built-in; silently falls back to Python
         self.use_native = use_native
         self.jobs: Dict[str, JobState] = {}
-        self._last_rescale: Dict[str, float] = {}
+        self._gate = HysteresisGate(rescale_cooldown_s)
         self._events: "queue.Queue[Event]" = queue.Queue()
         self._stop = threading.Event()
 
@@ -441,12 +473,9 @@ class Autoscaler:
         have_pending = self._find_pending_job()
         candidates = self._reschedulable(have_pending)
         if self.rescale_cooldown_s > 0 and not self._any_pending_pods():
-            now = time.monotonic()
             candidates = [
-                j
-                for j in candidates
-                if now - self._last_rescale.get(j.config.qualified_name, -1e18)
-                >= self.rescale_cooldown_s
+                j for j in candidates
+                if self._gate.ready(j.config.qualified_name)
             ]
         diff = None
         if self.use_native:
@@ -483,7 +512,7 @@ class Autoscaler:
                     group.parallelism = t
                     self.cluster.update_worker_group(group)
                     self.jobs[name].group = group
-                    self._last_rescale[name] = time.monotonic()
+                    self._gate.record(name)
                     accel = self.jobs[name].config.spec.accelerator_type
                     log.info(
                         "scaled job",

@@ -468,6 +468,10 @@ class ContinuousBatchingEngine:
         # request popped from the queue but not yet slotted — requeued
         # at the head if the admission prefill faults
         self._admitting: Optional[Request] = None
+        # half-close flag (graceful drain): admission stops, in-flight
+        # slots run to completion, queued requests stay intact for the
+        # caller to hand elsewhere (the router's scale-down/swap path)
+        self._draining = False
         # hardware-efficiency observability (doc/observability.md
         # "Hardware efficiency"): the analytic cost model prices each
         # dispatched program, the efficiency meter turns drained-block
@@ -664,9 +668,15 @@ class ContinuousBatchingEngine:
     def has_work(self) -> bool:
         return (
             self.active_slots > 0
-            or self.queue.depth > 0
+            or (self.queue.depth > 0 and not self._draining)
             or bool(self._inflight)
         )
+
+    @property
+    def draining(self) -> bool:
+        """True after :meth:`half_close`: admission is closed, queued
+        requests are residuals awaiting :meth:`take_residual`."""
+        return self._draining
 
     def step(self) -> int:
         """One engine iteration: admit up to the block budget of queued
@@ -690,7 +700,7 @@ class ContinuousBatchingEngine:
     def _step_inner(self) -> int:
         emitted = 0
         self._evict_overdue()
-        if self.queue.depth > 0:
+        if self.queue.depth > 0 and not self._draining:
             if self._inflight and not any(s is None for s in self._slots):
                 # drain-to-admit: no slot is known-free, but an
                 # in-flight block may have finished one — sync now so
@@ -759,6 +769,65 @@ class ContinuousBatchingEngine:
             except Exception as e:
                 self._recover(e)
         return dict(self.results)
+
+    # -- graceful drain (half-close) ----------------------------------------
+
+    def half_close(self) -> None:
+        """Stop admitting queued requests. In-flight slots keep decoding
+        to their natural finish; queued requests are untouched and stay
+        admission-validated for whoever picks them up (the fleet router
+        requeues them onto another replica on scale-down/weight swap).
+        Idempotent."""
+        if self._draining:
+            return
+        self._draining = True
+        flight.emit(
+            "serve.halfclose",
+            queued=self.queue.depth, active=self.active_slots,
+        )
+
+    def reopen(self) -> None:
+        """Undo :meth:`half_close` (a cancelled drain resumes admission)."""
+        self._draining = False
+
+    def take_residual(self) -> List[Request]:
+        """Pop every still-queued request, in FIFO order. Only
+        meaningful after :meth:`half_close`; the caller owns the
+        returned requests (requeue them elsewhere or fail them) — the
+        engine forgets them."""
+        residual: List[Request] = []
+        while True:
+            req = self.queue.pop()
+            if req is None:
+                break
+            residual.append(req)
+        flight.emit(
+            "serve.drained",
+            residual=len(residual), served=len(self.results),
+        )
+        return residual
+
+    def drain(self, max_steps: Optional[int] = None) -> List[Request]:
+        """Graceful half-close drain: stop admission, run in-flight
+        slots to completion (every accepted request reaches a terminal
+        outcome in ``results``), then return the residual queued
+        requests intact. After this returns no further token can be
+        emitted — there is no active slot and no in-flight block left.
+        ``max_steps`` bounds the finish loop (None = run to quiescence;
+        a bounded drain may return with slots still live)."""
+        self.half_close()
+        steps = 0
+        while (self.active_slots > 0 or self._inflight) and (
+            max_steps is None or steps < max_steps
+        ):
+            self.step()
+            steps += 1
+        if self._inflight:
+            try:
+                self._drain_all()
+            except Exception as e:
+                self._recover(e)
+        return self.take_residual()
 
     # -- internals ----------------------------------------------------------
 

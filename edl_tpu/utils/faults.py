@@ -32,6 +32,12 @@ Triggers (exactly one per spec)
                  interleaving across sites
     ``max=M``    (modifier) cap total firings of this spec at M
 
+The site catalog lives in doc/robustness.md §2; the serving fleet adds
+``router.forward`` (the router's forward-to-replica wire),
+``replica.spawn`` (supervisor process launch), and ``replica.health``
+(the supervisor's health probe) — armed drops there exercise the same
+failover/respawn paths a SIGKILL exercises from outside.
+
 ``EDL_FAULTS`` may instead name a JSON file (path to an existing file,
 or ``@path``): ``{"seed": 0, "faults": [{"site": "serve.dispatch",
 "action": "raise", "n": 3}, ...]}``. ``EDL_FAULTS_SEED`` seeds the

@@ -1,0 +1,364 @@
+"""Fleet chaos soak: SIGKILL, drain-before-evict, and rolling swaps
+against a REAL replica fleet, with hard zero-lost/zero-dup invariants.
+
+One dryrun fleet (N subprocess replicas, each `edl fleet --replica`
+around a tiny identically-seeded model) serves seeded traffic through
+the fault-tolerant router while the lanes break it:
+
+**kill** — a replica is SIGKILLed mid-traffic with streams attached,
+plus an armed ``router.forward:drop@n=2`` (the in-process version of
+the same wire failure). Every request must finish done/eos with
+tokens IDENTICAL to the fault-free reference — the router replays
+``prompt + received`` on a survivor — and the supervisor must respawn
+the fleet back to target.
+
+**scaledown** — drain-before-evict under armed ``replica.health``
+probe flaps: the victim half-closes, in-flight streams finish,
+queued residuals requeue elsewhere, and the flapped replica's
+SUSPECT→READY resurrect emits the ``replica.recover`` the postmortem
+chain verifies.
+
+**swap** — a rolling weight swap mid-traffic (drain → evict → spawn
+gen+1, one at a time; READY never below N−1) with armed
+``router.forward`` drops and one ``replica.spawn`` failure (the
+retry recovers it).
+
+Every lane asserts: exactly ONE terminal result per rid (zero lost,
+zero duplicated), outcomes done/eos, token identity vs the in-process
+fault-free reference, and that armed faults actually FIRED. Each lane
+dumps a merged event timeline (router process + every replica's
+/events, evicted replicas scraped before the kill) to
+``$EVDIR/chaos-fleet-<lane>.jsonl``; run_tests.sh phase 11 then gates
+on ``edl postmortem --assert-recovered --sites router.`` over those
+dumps, and this script runs the ``replica.``-site verification
+in-process.
+
+    python scripts/exp_fleet.py --dryrun [--seed 0] [--events-dir D]
+"""
+
+import argparse
+import json
+import os
+import signal
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from edl_tpu.utils.platform import force_virtual_cpu  # noqa: E402
+
+force_virtual_cpu(8)
+
+import jax  # noqa: E402
+
+from edl_tpu.models import llama  # noqa: E402
+from edl_tpu.obs import events as flight  # noqa: E402
+from edl_tpu.obs import postmortem as pm  # noqa: E402
+from edl_tpu.serving.engine import ContinuousBatchingEngine  # noqa: E402
+from edl_tpu.serving.fleet import (  # noqa: E402
+    ReplicaSpec,
+    ReplicaSupervisor,
+    ServingFleet,
+)
+from edl_tpu.serving.router import (  # noqa: E402
+    HttpTransport,
+    ReplicaTable,
+    Router,
+)
+from edl_tpu.serving.scheduler import Request  # noqa: E402
+from edl_tpu.utils import faults  # noqa: E402
+
+VOCAB = 96
+MODEL_SEED = 1  # must match ReplicaSpec.seed → identical replica weights
+N_REPLICAS = 3
+
+
+def build_workload(lane, n, seed):
+    import random
+
+    # str-seeded Random is deterministic across processes (no hash salt)
+    rng = random.Random(f"{seed}/{lane}")
+    reqs = []
+    for i in range(n):
+        prompt = [rng.randrange(2, VOCAB) for _ in range(3 + i % 6)]
+        reqs.append({
+            "rid": f"{lane}-{i}", "prompt": prompt, "max_new": 6 + i % 5,
+        })
+    return reqs
+
+
+def reference_tokens(all_reqs):
+    """Fault-free ground truth: the same tiny model served in-process.
+    Greedy tokens are horizon-invariant, so this single engine is the
+    oracle for every replica no matter the fleet's churn."""
+    cfg = llama.LlamaConfig.tiny(vocab=VOCAB)
+    params = jax.jit(
+        lambda: llama.init_params(jax.random.PRNGKey(MODEL_SEED), cfg)
+    )()
+    eng = ContinuousBatchingEngine(
+        params, cfg, max_slots=4, max_len=96, horizon=4
+    )
+    ref = {}
+    pend = []
+    for r in all_reqs:
+        key = (tuple(r["prompt"]), r["max_new"])
+        if key in ref or key in [k for k, _ in pend]:
+            continue
+        rid = f"ref{len(pend)}"
+        eng.submit(rid, r["prompt"], r["max_new"])
+        pend.append((key, rid))
+    res = eng.run()
+    for key, rid in pend:
+        assert res[rid].outcome in ("done", "eos"), (rid, res[rid].outcome)
+        ref[key] = res[rid].tokens
+    return ref
+
+
+def drive(fleet, reqs, stagger_s=0.02):
+    results = {}
+    lock = threading.Lock()
+
+    def one(r):
+        res = fleet.generate(
+            Request(rid=r["rid"], prompt=r["prompt"], max_new=r["max_new"])
+        )
+        with lock:
+            assert r["rid"] not in results, f"DUPLICATE result {r['rid']}"
+            results[r["rid"]] = res
+
+    threads = []
+    for r in reqs:
+        t = threading.Thread(target=one, args=(r,))
+        t.start()
+        threads.append(t)
+        time.sleep(stagger_s)
+    return threads, results
+
+
+def check_lane(lane, reqs, results, ref):
+    assert set(results) == {r["rid"] for r in reqs}, (
+        f"{lane}: lost requests: "
+        f"{sorted({r['rid'] for r in reqs} - set(results))}"
+    )
+    for r in reqs:
+        res = results[r["rid"]]
+        assert res.outcome in ("done", "eos"), (
+            f"{lane}: {r['rid']} finished {res.outcome!r}"
+        )
+        want = ref[(tuple(r["prompt"]), r["max_new"])]
+        assert res.tokens == want, (
+            f"{lane}: {r['rid']} tokens diverged after "
+            f"{res.failovers} failover(s): {res.tokens} != {want}"
+        )
+    print(f"  [{lane}] {len(reqs)} requests done/eos, token-identical "
+          f"(failovers={sum(r.failovers for r in results.values())})")
+
+
+def dump_merged(path, sup, table, evicted_events):
+    """One timeline: the router/supervisor process's recorder plus
+    every live replica's /events scrape plus the pre-evict scrapes —
+    ordered by wall clock so cross-process postmortem chains hold."""
+    recs = list(flight.default_recorder().records())
+    for records in evicted_events:
+        recs.extend(records)
+    for rid in table.ids():
+        h = sup.handle(rid)
+        if h is None or not h.url:
+            continue
+        try:
+            recs.extend(pm.load_events(h.url))
+        except ValueError:
+            pass  # fresh replica, empty recorder — nothing to merge
+        except (ConnectionError, OSError) as e:
+            print(f"  WARN: /events scrape of {rid} failed: {e}",
+                  file=sys.stderr)
+    recs.sort(key=lambda e: (e.get("t_wall", 0.0), e.get("seq", 0)))
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    return recs
+
+
+def wait_fleet_healed(table, n, gone=None, timeout_s=180.0):
+    """Wait until death detection has REAPED ``gone`` (a freshly killed
+    replica sits READY in the table until probe failures accumulate, so
+    ready_count alone would pass trivially) and the respawn is READY."""
+    t0 = time.monotonic()
+    while (gone is not None and gone in table.ids()) or (
+        table.ready_count() < n
+    ):
+        assert time.monotonic() - t0 < timeout_s, (
+            f"fleet never healed to {n} READY replicas "
+            f"(ids={table.ids()}, ready={table.ready_count()})"
+        )
+        time.sleep(0.1)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--dryrun", action="store_true",
+                    help="CI lane (fixed small workload; the only mode)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--requests", type=int, default=10,
+                    help="requests per lane")
+    ap.add_argument("--events-dir", default=None,
+                    help="dump per-lane merged event JSONL here "
+                    "(chaos-fleet-<lane>.jsonl) for `edl postmortem "
+                    "--assert-recovered --sites router.`")
+    args = ap.parse_args()
+    if args.events_dir:
+        os.makedirs(args.events_dir, exist_ok=True)
+
+    lanes = ["kill", "scaledown", "swap"]
+    workloads = {ln: build_workload(ln, args.requests, args.seed)
+                 for ln in lanes}
+    print("== reference: fault-free in-process run ==")
+    ref = reference_tokens([r for ln in lanes for r in workloads[ln]])
+
+    workdir = tempfile.mkdtemp(prefix="edl-fleet-chaos-")
+    spec = ReplicaSpec(workdir=workdir, vocab=VOCAB, slots=4, max_len=96,
+                       horizon=4, seed=MODEL_SEED)
+    table = ReplicaTable()
+    evicted_events = []
+    sup = ReplicaSupervisor(
+        table, spec,
+        events_sink=lambda rid, recs: evicted_events.append(recs),
+    )
+    router = Router(table, transport=HttpTransport(), seed=args.seed,
+                    pick_wait_s=30.0)
+    fleet = ServingFleet(sup, router)
+    ok = False
+
+    def lane_dump(lane):
+        if args.events_dir:
+            path = os.path.join(args.events_dir,
+                                f"chaos-fleet-{lane}.jsonl")
+            recs = dump_merged(path, sup, table, evicted_events)
+            print(f"  [{lane}] merged timeline -> {path} "
+                  f"({len(recs)} events)")
+            return recs
+        return dump_merged(os.devnull, sup, table, evicted_events)
+
+    try:
+        print(f"== boot: {N_REPLICAS} replicas (workdir {workdir}) ==")
+        fleet.start(N_REPLICAS)
+
+        # -- lane 1: SIGKILL mid-stream + armed router.forward drop ---------
+        print("== lane kill: SIGKILL a replica mid-traffic ==")
+        faults.arm("router.forward:drop@n=2", seed=args.seed)
+        threads, results = drive(fleet, workloads["kill"])
+        victim = table.ids()[0]
+        vproc = sup.handle(victim).proc
+        time.sleep(0.25)  # let streams attach to the victim
+        vproc.send_signal(signal.SIGKILL)
+        print(f"  [kill] SIGKILL -> {victim} (pid {vproc.pid})")
+        for t in threads:
+            t.join(timeout=120)
+            assert not t.is_alive(), "request wedged after SIGKILL"
+        fired = faults.counts()
+        faults.disarm()
+        assert fired.get("router.forward", 0) >= 1, (
+            "armed router.forward drop never fired"
+        )
+        check_lane("kill", workloads["kill"], results, ref)
+        # the supervisor heals the fleet back to target
+        wait_fleet_healed(table, N_REPLICAS, gone=victim)
+        print(f"  [kill] fleet healed to {table.ready_count()} READY")
+        evs = lane_dump("kill")
+        probs = pm.verify_recovered(evs, site_prefix="router.")
+        assert not probs, f"kill-lane postmortem: {probs}"
+
+        # -- lane 2: drain-before-evict scale-down + health flaps -----------
+        print("== lane scaledown: drain-before-evict under probe flaps ==")
+        faults.arm("replica.health:raise@every=2,max=2", seed=args.seed)
+        threads, results = drive(fleet, workloads["scaledown"])
+        time.sleep(0.15)
+        requeued = fleet.scale_down()
+        for t in threads:
+            t.join(timeout=120)
+            assert not t.is_alive(), "request wedged during scale-down"
+        # let the prober's next good probes clear the armed flaps
+        deadline = time.monotonic() + 30.0
+        while (faults.counts().get("replica.health", 0) < 2
+               and time.monotonic() < deadline):
+            time.sleep(0.1)
+        fired = faults.counts()
+        faults.disarm()
+        assert fired.get("replica.health", 0) >= 2, (
+            "armed replica.health flaps never fired"
+        )
+        for res in requeued:
+            results.setdefault(res.rid, res)
+        check_lane("scaledown", workloads["scaledown"], results, ref)
+        assert len(table.ids()) == N_REPLICAS - 1, table.ids()
+        assert evicted_events, "evict path never scraped victim events"
+        print(f"  [scaledown] {len(requeued)} residual(s) requeued, "
+              f"fleet at {len(table.ids())} replicas")
+        # wait for the SUSPECT->READY resurrect to land, then verify
+        # the replica.* chains in-process (phase 11 verifies router.*)
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            evs = lane_dump("scaledown")
+            if not pm.verify_recovered(evs, site_prefix="replica."):
+                break
+            time.sleep(0.2)
+        probs = pm.verify_recovered(evs, site_prefix="replica.")
+        assert not probs, f"scaledown-lane postmortem: {probs}"
+
+        # -- lane 3: rolling weight swap + forward drops + spawn retry ------
+        # back to N replicas first: a forward-drop excludes one replica
+        # for that request, and with only two left a concurrently
+        # draining victim could leave zero routable — three keeps a
+        # READY fallback through every (drop, drain) overlap
+        print("== lane swap: rolling weight swap mid-traffic ==")
+        fleet.scale_up()
+        faults.arm(
+            "router.forward:drop@every=4,max=2;replica.spawn:raise@n=1",
+            seed=args.seed,
+        )
+        threads, results = drive(fleet, workloads["swap"])
+        time.sleep(0.1)
+        new_gen = fleet.rolling_swap()
+        for t in threads:
+            t.join(timeout=180)
+            assert not t.is_alive(), "request wedged during swap"
+        fired = faults.counts()
+        faults.disarm()
+        assert fired.get("router.forward", 0) >= 1, (
+            "armed router.forward drops never fired during the swap"
+        )
+        assert fired.get("replica.spawn", 0) == 1, (
+            "armed replica.spawn fault never fired"
+        )
+        check_lane("swap", workloads["swap"], results, ref)
+        floor = sup.min_ready_observed
+        assert floor is not None and floor >= len(table.ids()) - 1, (
+            f"swap dropped READY to {floor}"
+        )
+        reps = table.snapshot()
+        assert all(r.generation == new_gen for r in reps), (
+            [(r.id, r.generation) for r in reps]
+        )
+        print(f"  [swap] all replicas at generation {new_gen}, "
+              f"READY floor {floor}")
+        evs = lane_dump("swap")
+        for prefix in ("router.", "replica."):
+            probs = pm.verify_recovered(evs, site_prefix=prefix)
+            assert not probs, f"swap-lane postmortem ({prefix}*): {probs}"
+
+        print("EXP FLEET CHAOS OK")
+        ok = True
+        return 0
+    finally:
+        faults.disarm()
+        fleet.stop()
+        if ok:  # keep replica logs around when a lane failed
+            import shutil
+
+            shutil.rmtree(workdir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -206,6 +206,40 @@ timeout -k 10 60 python -m edl_tpu.cli schedcheck --budget 24 --seed 0
 rc10=$?
 t10=$(date +%s)
 echo "== phase 10 done in $((t10 - t9))s (rc=$rc10) =="
-echo "== total $((t10 - t0))s =="
 
-[ "$rc0" -eq 0 ] && [ "$rc1" -eq 0 ] && [ "$rc2" -eq 0 ] && [ "$rc3" -eq 0 ] && [ "$rc4" -eq 0 ] && [ "$rc5" -eq 0 ] && [ "$rc6" -eq 0 ] && [ "$rc7" -eq 0 ] && [ "$rc8" -eq 0 ] && [ "$rc9" -eq 0 ] && [ "$rc10" -eq 0 ]
+echo "== phase 11: fleet chaos lane (exp_fleet --dryrun + postmortem gate) =="
+# the serving fleet under real process-level chaos: N replica
+# SUBPROCESSES behind the fault-tolerant router, one lane each for
+# SIGKILL-mid-stream, drain-before-evict scale-down under probe flaps,
+# and a rolling weight swap with forward drops + a spawn failure.
+# exp_fleet hard-asserts zero lost / zero duplicated requests (exactly
+# one terminal result per rid, outcome done/eos), token identity vs
+# the fault-free in-process reference across every failover, that
+# every armed fault FIRED, and the swap's N-1 up floor. The merged
+# per-lane timelines (router process + every replica's /events) are
+# then re-verified from OUTSIDE by `edl postmortem --assert-recovered`:
+# fault -> recover -> re-prefill -> finish for each affected rid.
+FLDIR="${TMPDIR:-/tmp}/edl-fleet-events.$$"
+rm -rf "$FLDIR"
+rc11=0
+JAX_PLATFORMS=cpu python scripts/exp_fleet.py --dryrun --seed 0 \
+    --events-dir "$FLDIR" || rc11=1
+for f in "$FLDIR"/chaos-fleet-kill.jsonl "$FLDIR"/chaos-fleet-swap.jsonl; do
+  [ -e "$f" ] || { echo "missing fleet dump $f"; rc11=1; continue; }
+  python -m edl_tpu.cli postmortem "$f" --assert-recovered \
+      --sites router. > /dev/null \
+    || { echo "postmortem FAILED for $f (router.*)"; rc11=1; }
+done
+for f in "$FLDIR"/chaos-fleet-scaledown.jsonl \
+         "$FLDIR"/chaos-fleet-swap.jsonl; do
+  [ -e "$f" ] || { echo "missing fleet dump $f"; rc11=1; continue; }
+  python -m edl_tpu.cli postmortem "$f" --assert-recovered \
+      --sites replica. > /dev/null \
+    || { echo "postmortem FAILED for $f (replica.*)"; rc11=1; }
+done
+rm -rf "$FLDIR"
+t11=$(date +%s)
+echo "== phase 11 done in $((t11 - t10))s (rc=$rc11) =="
+echo "== total $((t11 - t0))s =="
+
+[ "$rc0" -eq 0 ] && [ "$rc1" -eq 0 ] && [ "$rc2" -eq 0 ] && [ "$rc3" -eq 0 ] && [ "$rc4" -eq 0 ] && [ "$rc5" -eq 0 ] && [ "$rc6" -eq 0 ] && [ "$rc7" -eq 0 ] && [ "$rc8" -eq 0 ] && [ "$rc9" -eq 0 ] && [ "$rc10" -eq 0 ] && [ "$rc11" -eq 0 ]

@@ -1,0 +1,680 @@
+"""Elastic serving fleet: router, replica table, supervisor, scaler.
+
+Everything here is jax-free — the routing/supervision logic runs
+against fake transports, fake spawn/probe/drain hooks, and a fake
+engine behind the REAL replica HTTP server, so the orchestration
+contracts (token-identical failover replay, drain-before-evict with
+zero lost/duplicated requests, rolling swaps holding the READY floor,
+hysteresis-damped scaling) are pinned without booting a model. The
+real-subprocess end-to-end lives in scripts/exp_fleet.py (chaos lane,
+run_tests.sh phase 11).
+"""
+
+import threading
+import time
+
+import pytest
+
+from edl_tpu.obs import events as flight
+from edl_tpu.obs.metrics import MetricsRegistry, parse_prometheus_text
+from edl_tpu.obs.top import summarize
+from edl_tpu.serving import router as rt
+from edl_tpu.serving.fleet import (
+    FleetScaler,
+    ReplicaHandle,
+    ReplicaSupervisor,
+    ServingFleet,
+)
+from edl_tpu.serving.replica import ReplicaServer
+from edl_tpu.serving.router import (
+    DEAD,
+    DRAINING,
+    READY,
+    SUSPECT,
+    ReplicaTable,
+    RouteRejected,
+    Router,
+    http_json,
+)
+from edl_tpu.serving.scheduler import Request
+from edl_tpu.utils import faults
+
+
+def _fake_model(prompt, max_new):
+    """Deterministic stateless 'greedy decode': token j depends only on
+    prompt + previously generated tokens, so serving (prompt + got,
+    max_new - len(got)) continues the SAME sequence — the same replay
+    contract the real engine's greedy decode gives the router."""
+    seq = list(prompt)
+    out = []
+    for _ in range(max_new):
+        t = (sum(seq) * 31 + len(seq)) % 211
+        out.append(t)
+        seq.append(t)
+    return out
+
+
+def _table(n=2, registry=None, **kw):
+    table = ReplicaTable(registry=registry or MetricsRegistry(), **kw)
+    for i in range(n):
+        table.add(f"r{i}", f"fake://r{i}")
+        table.set_state(f"r{i}", READY)
+    return table
+
+
+def _serving_transport(served=None):
+    """Transport that 'decodes' with _fake_model on whatever replica
+    gets picked."""
+
+    def transport(ref, payload, on_tokens):
+        toks = _fake_model(payload["prompt"], payload["max_new"])
+        on_tokens(toks)
+        if served is not None:
+            served.append((ref.id, payload["rid"]))
+        return "done"
+
+    return transport
+
+
+# -- replica table: state machine + routing ---------------------------------
+
+
+def test_table_probe_state_machine_and_sticky_states():
+    table = _table(1, suspect_after=1, dead_after=3)
+    assert table.mark_probe("r0", ok=False) == SUSPECT
+    # one good probe resurrects and resets the streak
+    assert table.mark_probe("r0", ok=True, queue_depth=5) == READY
+    assert table.get("r0").queue_depth == 5
+    assert table.mark_probe("r0", ok=False) == SUSPECT
+    assert table.mark_probe("r0", ok=False) == SUSPECT
+    assert table.mark_probe("r0", ok=False) == DEAD
+    # DEAD is sticky: a late good probe must not resurrect
+    assert table.mark_probe("r0", ok=True) == DEAD
+    table2 = _table(1)
+    table2.set_state("r0", DRAINING)
+    # DRAINING is sticky against probes (the supervisor owns it)
+    assert table2.mark_probe("r0", ok=True) == DRAINING
+    assert table2.acquire() is None
+
+
+def test_table_least_load_session_pin_and_affinity():
+    table = _table(3)
+    # least queue_depth + inflight wins
+    table.mark_probe("r0", ok=True, queue_depth=9)
+    table.mark_probe("r1", ok=True, queue_depth=0)
+    table.mark_probe("r2", ok=True, queue_depth=9)
+    ref = table.acquire()
+    assert ref.id == "r1"
+    # session pin: same session sticks to its replica while READY
+    ref2 = table.acquire(session="sess")
+    for _ in range(3):
+        again = table.acquire(session="sess")
+        assert again.id == ref2.id
+        table.release(again.id)
+    # prefix affinity is deterministic while within the slack
+    table3 = _table(3, affinity_slack=100)
+    picks = {table3.acquire(prefix_key="1,2,3").id for _ in range(4)}
+    assert len(picks) == 1
+    # ... but never overrides a big load imbalance
+    table4 = _table(2, affinity_slack=1)
+    affine = table4.acquire(prefix_key="k").id
+    other = "r0" if affine == "r1" else "r1"
+    table4.mark_probe(affine, ok=True, queue_depth=50)
+    assert table4.acquire(prefix_key="k").id == other
+
+
+def test_table_acquire_excludes_and_remove_purges_sessions():
+    table = _table(2)
+    ref = table.acquire(session="s", exclude=["r0"])
+    assert ref.id == "r1"
+    assert table.acquire(exclude=["r0", "r1"]) is None
+    table.remove("r1")
+    # the pin died with its replica: no stale session entry remains
+    assert table.acquire(session="s").id == "r0"
+
+
+# -- router: failover replay, budgets, requeue ------------------------------
+
+
+def test_router_failover_replays_token_identical():
+    """A replica that dies mid-stream costs nothing: the router
+    replays prompt + received on a survivor and the final stream is
+    identical to a failure-free run."""
+    table = _table(2, registry=MetricsRegistry())
+    reg = MetricsRegistry()
+    prompt, max_new = [3, 1, 4, 1, 5], 8
+    want = _fake_model(prompt, max_new)
+    first = {"armed": True}
+
+    def transport(ref, payload, on_tokens):
+        toks = _fake_model(payload["prompt"], payload["max_new"])
+        if first.pop("armed", None):
+            on_tokens(toks[:3])  # 3 tokens escape, then the wire dies
+            raise ConnectionError("replica gone mid-stream")
+        on_tokens(toks)
+        return "done"
+
+    router = Router(table, transport=transport, registry=reg,
+                    backoff_base_s=0.0, sleep=lambda s: None)
+    res = router.generate(Request(rid="x", prompt=prompt, max_new=max_new))
+    assert res.outcome == "done"
+    assert res.tokens == want
+    assert res.failovers == 1
+    # the failed replica took a probe strike and the events tell the
+    # postmortem story: failover + recover carrying the rid
+    kinds = {r["kind"] for r in flight.default_recorder().records()}
+    assert {"replica.failover", "router.recover"} <= kinds
+
+
+def test_router_failover_budget_bounded():
+    table = _table(3, registry=MetricsRegistry())
+
+    def transport(ref, payload, on_tokens):
+        raise ConnectionError("always down")
+
+    router = Router(table, transport=transport, max_failovers=1,
+                    registry=MetricsRegistry(),
+                    backoff_base_s=0.0, sleep=lambda s: None)
+    res = router.generate(Request(rid="x", prompt=[1], max_new=4))
+    assert res.outcome == "failed"
+    assert res.failovers == 2  # initial + max_failovers, then give up
+
+
+def test_router_rejection_is_terminal():
+    table = _table(2, registry=MetricsRegistry())
+    calls = []
+
+    def transport(ref, payload, on_tokens):
+        calls.append(ref.id)
+        raise RouteRejected("over_capacity", "queue full")
+
+    router = Router(table, transport=transport,
+                    registry=MetricsRegistry())
+    res = router.generate(Request(rid="x", prompt=[1], max_new=4))
+    assert res.outcome == "rejected:over_capacity"
+    assert len(calls) == 1  # no retry storm on an admission refusal
+
+
+def test_router_requeued_reroutes_without_failover_budget():
+    """A drain-displaced request ("requeued" terminal, zero tokens)
+    re-routes whole and finishes elsewhere — without burning failover
+    budget and without a duplicate run."""
+    table = _table(2, registry=MetricsRegistry())
+    served = []
+
+    def transport(ref, payload, on_tokens):
+        if not served:
+            served.append(("drained", ref.id))
+            return "requeued"
+        served.append((ref.id, payload["rid"]))
+        on_tokens(_fake_model(payload["prompt"], payload["max_new"]))
+        return "done"
+
+    router = Router(table, transport=transport, max_failovers=0,
+                    registry=MetricsRegistry())
+    res = router.generate(Request(rid="x", prompt=[2, 7], max_new=5))
+    assert res.outcome == "done"
+    assert res.tokens == _fake_model([2, 7], 5)
+    assert res.failovers == 0
+    assert len([s for s in served if s[0] != "drained"]) == 1
+
+
+def test_router_deadline_timeout_without_replicas():
+    table = ReplicaTable(registry=MetricsRegistry())  # empty fleet
+    clk = {"t": 0.0}
+
+    def clock():
+        clk["t"] += 0.5
+        return clk["t"]
+
+    router = Router(table, transport=_serving_transport(),
+                    registry=MetricsRegistry(), pick_wait_s=10.0,
+                    clock=clock, sleep=lambda s: None)
+    res = router.generate(
+        Request(rid="x", prompt=[1], max_new=2, deadline_s=2.0)
+    )
+    assert res.outcome == "timeout"
+    assert res.tokens == []
+
+
+# -- fault sites on the real paths ------------------------------------------
+
+
+def test_fault_site_router_forward_armed_drop_fails_over():
+    table = _table(2, registry=MetricsRegistry())
+    served = []
+    router = Router(table, transport=_serving_transport(served),
+                    registry=MetricsRegistry(),
+                    backoff_base_s=0.0, sleep=lambda s: None)
+    faults.arm("router.forward:drop@n=1", seed=0)
+    try:
+        res = router.generate(Request(rid="x", prompt=[5], max_new=3))
+        assert res.outcome == "done"
+        assert res.tokens == _fake_model([5], 3)
+        assert res.failovers == 1
+        assert faults.counts().get("router.forward") == 1
+    finally:
+        faults.disarm()
+    assert len(served) == 1  # exactly one replica ran it
+
+
+def test_fault_site_replica_spawn_armed_raise_retries():
+    table = ReplicaTable(registry=MetricsRegistry())
+    health = {"status": "ok", "queue_depth": 0}
+    sup = ReplicaSupervisor(
+        table,
+        spawn_fn=lambda rid, gen: ReplicaHandle(
+            id=rid, generation=gen, url=f"fake://{rid}"
+        ),
+        probe_fn=lambda url: dict(health),
+        drain_fn=lambda url: {"residual": [], "served": 0},
+        spawn_retries=1, sleep=lambda s: None,
+    )
+    faults.arm("replica.spawn:raise@n=1", seed=0)
+    try:
+        rid = sup.spawn()
+        sup.wait_ready(rid)
+        assert table.get(rid).state == READY
+        assert faults.counts().get("replica.spawn") == 1
+    finally:
+        faults.disarm()
+    # an exhausted retry budget surfaces instead of half-spawning
+    faults.arm("replica.spawn:raise@every=1", seed=0)
+    try:
+        with pytest.raises(RuntimeError, match="failed to spawn"):
+            sup.spawn()
+    finally:
+        faults.disarm()
+
+
+def test_fault_site_replica_health_flap_suspects_then_recovers():
+    table = ReplicaTable(registry=MetricsRegistry(), suspect_after=1,
+                         dead_after=3)
+    sup = ReplicaSupervisor(
+        table,
+        spawn_fn=lambda rid, gen: ReplicaHandle(
+            id=rid, generation=gen, url=f"fake://{rid}"
+        ),
+        probe_fn=lambda url: {"status": "ok", "queue_depth": 0},
+        sleep=lambda s: None,
+    )
+    rid = sup.spawn()
+    sup.wait_ready(rid)
+    faults.arm("replica.health:raise@every=1,max=2", seed=0)
+    try:
+        assert sup.probe_once(rid) == SUSPECT
+        assert sup.probe_once(rid) == SUSPECT
+        assert faults.counts().get("replica.health") == 2
+        # the flap clears: resurrect, and say so for the postmortem
+        assert sup.probe_once(rid) == READY
+    finally:
+        faults.disarm()
+    recs = flight.default_recorder().records()
+    recov = [r for r in recs if r["kind"] == "replica.recover"
+             and r.get("corr", {}).get("worker") == rid]
+    assert recov, "SUSPECT→READY resurrect must emit replica.recover"
+
+
+# -- supervisor: death respawn, drain-before-evict, rolling swap ------------
+
+
+class _FakeFleetEnv:
+    """Shared state behind the supervisor's spawn/probe/drain fakes."""
+
+    def __init__(self):
+        self.health = {}   # url -> health doc (or ConnectionError)
+        self.residual = {}  # url -> residual docs handed out on drain
+        self.drained = []
+
+    def spawn_fn(self, rid, gen):
+        url = f"fake://{rid}"
+        self.health[url] = {"status": "ok", "queue_depth": 0}
+        return ReplicaHandle(id=rid, generation=gen, url=url)
+
+    def probe_fn(self, url):
+        doc = self.health[url]
+        if isinstance(doc, Exception):
+            raise doc
+        return dict(doc)
+
+    def drain_fn(self, url):
+        self.drained.append(url)
+        return {"residual": self.residual.get(url, []), "served": 1}
+
+
+def _supervisor(env, table=None, **kw):
+    table = table or ReplicaTable(registry=MetricsRegistry())
+    kw.setdefault("sleep", lambda s: None)
+    return ReplicaSupervisor(
+        table, spawn_fn=env.spawn_fn, probe_fn=env.probe_fn,
+        drain_fn=env.drain_fn, **kw
+    ), table
+
+
+def test_supervisor_death_respawns_to_target():
+    env = _FakeFleetEnv()
+    sup, table = _supervisor(env)
+    ids = [sup.spawn() for _ in range(2)]
+    for rid in ids:
+        sup.wait_ready(rid)
+    sup._target = 2
+    # r0 stops answering: three strikes walk it to DEAD, the
+    # supervisor reaps it and heals the fleet back to target
+    env.health["fake://r0"] = ConnectionError("kill -9")
+    for _ in range(3):
+        sup.probe_once("r0")
+    assert table.get("r0") is None
+    alive = table.ids()
+    assert len(alive) == 2 and "r1" in alive
+    new = [r for r in alive if r != "r1"][0]
+    assert table.get(new).state == READY
+    kinds = [r["kind"] for r in flight.default_recorder().records()]
+    assert "replica.dead" in kinds and "replica.recover" in kinds
+
+
+def test_supervisor_reaps_router_declared_dead():
+    # the ROUTER's mark_probe(ok=False) calls (one per failed forward)
+    # can walk a replica to DEAD between prober sweeps; DEAD is sticky,
+    # so the next probe_once must reap it or the zombie entry sits in
+    # the table forever and the fleet never heals back to target
+    env = _FakeFleetEnv()
+    sup, table = _supervisor(env)
+    ids = [sup.spawn() for _ in range(2)]
+    for rid in ids:
+        sup.wait_ready(rid)
+    sup._target = 2
+    for _ in range(table.dead_after):
+        table.mark_probe("r0", ok=False)
+    assert table.get("r0").state == DEAD
+    assert sup.probe_once("r0") == DEAD
+    assert table.get("r0") is None
+    alive = table.ids()
+    assert len(alive) == 2 and "r1" in alive
+    new = [r for r in alive if r != "r1"][0]
+    assert table.get(new).state == READY
+
+
+def test_supervisor_drain_before_evict_requeues_residual():
+    env = _FakeFleetEnv()
+    sup, table = _supervisor(env)
+    reg = MetricsRegistry()
+    for _ in range(2):
+        sup.wait_ready(sup.spawn())
+    sup._target = 2
+    env.residual["fake://r0"] = [
+        {"rid": "leftover", "prompt": [4, 2], "max_new": 3},
+    ]
+    served = []
+    router = Router(table, transport=_serving_transport(served),
+                    registry=reg)
+    fleet = ServingFleet(sup, router)
+    done = fleet.scale_down(victim="r0")
+    # drain happened BEFORE the evict, the residual reran through the
+    # router on the survivor, and nothing was lost or duplicated
+    assert env.drained == ["fake://r0"]
+    assert table.get("r0") is None
+    assert [r.rid for r in done] == ["leftover"]
+    assert done[0].outcome == "done"
+    assert done[0].tokens == _fake_model([4, 2], 3)
+    assert served == [("r1", "leftover")]
+    assert fleet.results["leftover"].outcome == "done"
+    kinds = [r["kind"] for r in flight.default_recorder().records()]
+    assert kinds.count("replica.drain") >= 1
+    assert kinds.count("replica.evict") >= 1
+
+
+def test_supervisor_rolling_swap_holds_ready_floor():
+    env = _FakeFleetEnv()
+    sup, table = _supervisor(env)
+    reg = MetricsRegistry()
+    n = 3
+    for _ in range(n):
+        sup.wait_ready(sup.spawn())
+    sup._target = n
+    router = Router(table, transport=_serving_transport(), registry=reg)
+    fleet = ServingFleet(sup, router)
+    gen = fleet.rolling_swap()
+    assert gen == 1
+    # one-at-a-time: READY never dropped below N-1
+    assert sup.min_ready_observed == n - 1
+    reps = table.snapshot()
+    assert len(reps) == n
+    assert all(r.generation == 1 and r.state == READY for r in reps)
+    # original ids all gone — every replica is a fresh process
+    assert not {f"r{i}" for i in range(n)} & set(table.ids())
+
+
+def test_supervisor_swap_residuals_requeue_through_router():
+    env = _FakeFleetEnv()
+    sup, table = _supervisor(env)
+    for _ in range(2):
+        sup.wait_ready(sup.spawn())
+    sup._target = 2
+    env.residual["fake://r1"] = [
+        {"rid": "displaced", "prompt": [9], "max_new": 2},
+    ]
+    served = []
+    router = Router(table, transport=_serving_transport(served),
+                    registry=MetricsRegistry())
+    fleet = ServingFleet(sup, router)
+    fleet.rolling_swap()
+    assert fleet.results["displaced"].outcome == "done"
+    assert [s[1] for s in served] == ["displaced"]
+
+
+# -- fleet scaler: hysteresis + SLO bypass ----------------------------------
+
+
+class _FakeScalableFleet:
+    def __init__(self):
+        self.ups = 0
+        self.downs = 0
+
+    def scale_up(self):
+        self.ups += 1
+
+    def scale_down(self):
+        self.downs += 1
+
+
+def test_fleet_scaler_depth_thresholds_and_cooldown():
+    table = _table(2)
+    clk = {"t": 0.0}
+    scaler = FleetScaler(
+        table, min_replicas=1, max_replicas=4,
+        depth_high=4.0, depth_low=0.5, cooldown_s=30.0,
+        clock=lambda: clk["t"],
+    )
+    fleet = _FakeScalableFleet()
+    # hot: mean depth 6 > 4 → up
+    for rid in table.ids():
+        table.mark_probe(rid, ok=True, queue_depth=6)
+    assert scaler.tick(fleet) == "up"
+    assert fleet.ups == 1
+    # still hot, but inside the cooldown → damped (no thrash)
+    assert scaler.tick(fleet) is None
+    clk["t"] = 31.0
+    assert scaler.tick(fleet) == "up"
+    # idle: mean depth 0 < 0.5 → down, after the cooldown
+    for rid in table.ids():
+        table.mark_probe(rid, ok=True, queue_depth=0)
+    clk["t"] = 62.0
+    assert scaler.tick(fleet) == "down"
+    assert fleet.downs == 1
+    # at min_replicas nothing scales down
+    table5 = _table(1)
+    scaler5 = FleetScaler(table5, min_replicas=1, max_replicas=4,
+                          cooldown_s=0.0, clock=lambda: 0.0)
+    assert scaler5.decide() is None
+
+
+def test_fleet_scaler_slo_breach_bypasses_cooldown():
+    table = _table(1)
+    ttft = {"p95": 0.01}
+    scaler = FleetScaler(
+        table, min_replicas=1, max_replicas=4, cooldown_s=1e9,
+        ttft_slo_s=0.2, ttft_p95_s=lambda: ttft["p95"],
+        clock=lambda: 0.0,
+    )
+    fleet = _FakeScalableFleet()
+    assert scaler.tick(fleet) is None  # SLO fine, load fine
+    ttft["p95"] = 0.9  # users are missing deadlines
+    assert scaler.tick(fleet) == "up"
+    assert scaler.tick(fleet) == "up"  # breach keeps bypassing
+    assert fleet.ups == 2
+
+
+# -- replica HTTP server over a fake engine ---------------------------------
+
+
+class _FakeQueue:
+    def __init__(self):
+        self._q = []
+
+    @property
+    def depth(self):
+        return len(self._q)
+
+    def push(self, r):
+        self._q.append(r)
+
+    def pop(self):
+        return self._q.pop(0) if self._q else None
+
+
+class _FakeEngine:
+    """Engine-shaped double for the replica server: one step serves one
+    queued request whole via _fake_model. ``serve=False`` freezes the
+    queue (a replica that never admits — the drain test's setup)."""
+
+    def __init__(self, serve=True):
+        self.queue = _FakeQueue()
+        self.results = {}
+        self._inflight = []
+        self._slots = []
+        self._draining = False
+        self._serve = serve
+
+    @property
+    def active_slots(self):
+        return 0
+
+    @property
+    def has_work(self):
+        return self._serve and not self._draining and self.queue.depth > 0
+
+    @property
+    def draining(self):
+        return self._draining
+
+    def submit(self, rid, prompt, max_new, **kw):
+        self.queue.push(Request(rid=rid, prompt=list(prompt),
+                                max_new=int(max_new)))
+
+    def step(self):
+        req = self.queue.pop()
+        if req is not None:
+            self.results[req.rid] = type(
+                "R", (), {"rid": req.rid,
+                          "tokens": _fake_model(req.prompt, req.max_new),
+                          "outcome": "done"},
+            )()
+
+    def half_close(self):
+        self._draining = True
+
+    def take_residual(self):
+        out = []
+        while True:
+            r = self.queue.pop()
+            if r is None:
+                break
+            out.append(r)
+        return out
+
+
+def test_replica_server_streams_and_reports_health():
+    eng = _FakeEngine()
+    with ReplicaServer(eng, generation=7,
+                       registry=MetricsRegistry()) as srv:
+        hz = http_json(srv.url, "/healthz")
+        assert hz["status"] == "ok" and hz["generation"] == 7
+        table = ReplicaTable(registry=MetricsRegistry())
+        table.add("r0", srv.url)
+        table.set_state("r0", READY)
+        router = Router(table, registry=MetricsRegistry())
+        res = router.generate(Request(rid="q1", prompt=[1, 2], max_new=4))
+        assert res.outcome == "done"
+        assert res.tokens == _fake_model([1, 2], 4)
+
+
+def test_replica_drain_over_http_requeues_attached_stream():
+    """The full drain handover over the real wire: a request queued on
+    a never-admitting replica gets displaced by /drain, its attached
+    router stream ends with the "requeued" terminal, and the SAME
+    router call finishes it on the second replica — exactly once."""
+    frozen, live = _FakeEngine(serve=False), _FakeEngine()
+    with ReplicaServer(frozen, registry=MetricsRegistry()) as s0, \
+            ReplicaServer(live, registry=MetricsRegistry()) as s1:
+        table = ReplicaTable(registry=MetricsRegistry())
+        table.add("r0", s0.url)
+        table.add("r1", s1.url)
+        table.set_state("r0", READY)
+        # r1 joins mid-flight, after the drain — like a swap target
+        router = Router(table, registry=MetricsRegistry(),
+                        pick_wait_s=10.0)
+        out = {}
+        t = threading.Thread(target=lambda: out.setdefault(
+            "res", router.generate(
+                Request(rid="moved", prompt=[6, 6], max_new=3))
+        ))
+        t.start()
+        deadline = time.monotonic() + 5.0
+        while frozen.queue.depth == 0 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert frozen.queue.depth == 1, "request never reached r0"
+        doc = http_json(s0.url, "/drain", body={})
+        assert [d["rid"] for d in doc["residual"]] == ["moved"]
+        table.set_state("r0", DRAINING)
+        table.set_state("r1", READY)
+        t.join(timeout=10)
+        assert not t.is_alive()
+        res = out["res"]
+        assert res.outcome == "done"
+        assert res.tokens == _fake_model([6, 6], 3)
+        assert res.failovers == 0  # a drain is not a failure
+        assert live.results["moved"].outcome == "done"
+        assert "moved" not in frozen.results or (
+            frozen.results["moved"].outcome == "requeued"
+        )
+
+
+def test_replica_server_rejects_while_draining():
+    eng = _FakeEngine()
+    with ReplicaServer(eng, registry=MetricsRegistry()) as srv:
+        http_json(srv.url, "/drain", body={})
+        with pytest.raises(RouteRejected) as ei:
+            from edl_tpu.serving.router import HttpTransport
+
+            HttpTransport()(
+                rt.ReplicaRef(id="r0", url=srv.url, generation=0),
+                {"rid": "x", "prompt": [1], "max_new": 1},
+                lambda toks: None,
+            )
+        assert ei.value.reason == "draining"
+
+
+# -- observability ----------------------------------------------------------
+
+
+def test_top_fleet_serving_strip():
+    reg = MetricsRegistry()
+    table = _table(2, registry=reg)
+    router = Router(table, transport=_serving_transport(), registry=reg)
+    router.generate(Request(rid="x", prompt=[1, 2, 3], max_new=4))
+    lines = summarize(parse_prometheus_text(reg.render()))
+    strip = [ln for ln in lines if "replicas_up=" in ln]
+    assert len(strip) == 1
+    assert "replicas_up=2" in strip[0]
+    assert "routed=1" in strip[0]
+    assert "failovers=0" in strip[0]

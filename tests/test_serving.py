@@ -20,6 +20,7 @@ import pytest
 
 from edl_tpu.models import llama
 from edl_tpu.monitor.collector import Collector, ServingSource
+from edl_tpu.obs import events as flight
 from edl_tpu.runtime.export import export_params
 from edl_tpu.serving.engine import ContinuousBatchingEngine
 from edl_tpu.serving.metrics import ServingMetrics
@@ -266,6 +267,48 @@ def test_engine_single_token_budget_and_slot_reuse():
     assert res["r1"].tokens == _sequential(list(range(1, 4)), 1)
     for i, (n, mn) in enumerate([(9, 7), (3, 1), (6, 5)]):
         assert res[f"r{i}"].tokens == _sequential(list(range(1, 1 + n)), mn)
+
+
+def test_engine_drain_half_close_pins_admission():
+    """Graceful drain (the fleet's drain-before-evict primitive):
+    after ``half_close()`` no queued request is admitted — not one
+    token is generated for them — while in-flight requests run to
+    their full budget token-identically; ``drain()`` then hands the
+    queued residuals back intact (order and fields preserved), and
+    ``reopen()`` restores admission."""
+    eng = ContinuousBatchingEngine(PARAMS, CFG, max_slots=2, max_len=64)
+    eng.submit("in0", [1, 2, 3, 4], 6)
+    eng.submit("in1", [5, 6, 7], 5)
+    eng.step()  # admits in0 (one prefill per step)
+    eng.step()  # admits in1 — both in flight now
+    # these land in the queue behind a full slot table
+    eng.submit("q0", [8, 9, 10], 4)
+    eng.submit("q1", [11, 12, 13, 14], 3)
+    assert eng.queue.depth == 2
+    residual = eng.drain()
+    # in-flight finished exactly as without the drain
+    assert eng.results["in0"].tokens == _sequential([1, 2, 3, 4], 6)
+    assert eng.results["in1"].tokens == _sequential([5, 6, 7], 5)
+    assert eng.results["in0"].outcome == "done"
+    # queued requests: zero tokens generated, residuals intact
+    assert [r.rid for r in residual] == ["q0", "q1"]
+    assert residual[0].prompt == [8, 9, 10]
+    assert residual[0].max_new == 4
+    assert residual[1].prompt == [11, 12, 13, 14]
+    assert "q0" not in eng.results and "q1" not in eng.results
+    assert eng.queue.depth == 0 and eng.active_slots == 0
+    assert eng.draining and not eng.has_work
+    # a half-closed engine refuses no submits (admission control is
+    # the queue's job) but never starts them
+    eng.submit("late", [2, 3], 2)
+    eng.step()
+    assert eng.active_slots == 0 and eng.queue.depth == 1
+    # reopen: the engine serves again, token-identically
+    eng.reopen()
+    res = eng.run()
+    assert res["late"].tokens == _sequential([2, 3], 2)
+    ev_kinds = [r["kind"] for r in flight.default_recorder().records()]
+    assert "serve.halfclose" in ev_kinds and "serve.drained" in ev_kinds
 
 
 def test_engine_int8_records_compose():
