@@ -987,6 +987,17 @@ def run_serve(args) -> int:
         print("--prefix-cache/--prefill-chunk require --block-size > 0",
               file=sys.stderr)
         return 1
+    if args.spec_k < 0:
+        print(f"--spec-k must be >= 0, got {args.spec_k}", file=sys.stderr)
+        return 1
+    if args.spec_k > 0 and args.temperature > 0:
+        print("--spec-k > 0 requires greedy decoding (temperature 0), "
+              f"got --temperature {args.temperature}", file=sys.stderr)
+        return 1
+    if args.spec_ngram < 1:
+        print(f"--spec-ngram must be >= 1, got {args.spec_ngram}",
+              file=sys.stderr)
+        return 1
     try:
         requests = _read_serve_requests(
             args.requests, args.max_new,
@@ -1035,6 +1046,9 @@ def run_serve(args) -> int:
         pool_blocks=args.pool_blocks or None,
         prefix_cache=args.prefix_cache,
         prefill_chunk=args.prefill_chunk,
+        spec_k=args.spec_k,
+        spec_ngram=args.spec_ngram,
+        spec_min_accept=args.spec_min_accept,
     )
     collector = Collector(ServingSource(metrics), out=sys.stderr)
 
@@ -1161,6 +1175,17 @@ def run_loadgen(args) -> int:
         print(f"--shared-prefix-len must be >= 1, got "
               f"{args.shared_prefix_len}", file=sys.stderr)
         return 1
+    if not 0.0 <= args.repetition <= 1.0:
+        print(f"--repetition must be in [0, 1], got {args.repetition}",
+              file=sys.stderr)
+        return 1
+    if args.repetition_len < 1:
+        print(f"--repetition-len must be >= 1, got {args.repetition_len}",
+              file=sys.stderr)
+        return 1
+    if args.spec_k < 0:
+        print(f"--spec-k must be >= 0, got {args.spec_k}", file=sys.stderr)
+        return 1
     if not (args.dryrun or args.workload_only or args.export_dir):
         print("error: need an EXPORT_DIR, --dryrun, or --workload-only",
               file=sys.stderr)
@@ -1203,6 +1228,8 @@ def run_loadgen(args) -> int:
         vocab=cfg.vocab if cfg is not None else args.vocab,
         shared_prefix_frac=args.shared_prefix,
         shared_prefix_len=args.shared_prefix_len,
+        repetition_frac=args.repetition,
+        repetition_len=args.repetition_len,
         classes=classes,
     )
     try:
@@ -1255,7 +1282,7 @@ def run_loadgen(args) -> int:
         # a PRIVATE registry — its traffic must not pollute /metrics.
         warm = ContinuousBatchingEngine(
             params, cfg, max_slots=slots, max_len=max_len,
-            horizon=args.horizon,
+            horizon=args.horizon, spec_k=args.spec_k,
             metrics=ServingMetrics(registry=MetricsRegistry()),
         )
         for r in reqs:
@@ -1275,7 +1302,7 @@ def run_loadgen(args) -> int:
     metrics = ServingMetrics()
     engine = ContinuousBatchingEngine(
         params, cfg, max_slots=slots, max_len=max_len,
-        horizon=args.horizon, metrics=metrics,
+        horizon=args.horizon, metrics=metrics, spec_k=args.spec_k,
     )
     cmap = spec.class_map()
     t0 = time.monotonic()
@@ -1302,6 +1329,21 @@ def run_loadgen(args) -> int:
         "rate_rps": spec.rate_rps, "requests": len(reqs),
         "speed": args.speed,
     }
+    if args.spec_k > 0:
+        # the speculative figures the CI gate and bench rungs read:
+        # acceptance rate and tokens landed per decode-phase dispatch
+        snap = metrics.snapshot()
+        decode_d = snap["dispatches_verify"] + snap["dispatches_decode"]
+        report["spec"] = {
+            "spec_k": args.spec_k,
+            "drafted": snap["spec_drafted"],
+            "accepted": snap["spec_accepted"],
+            "acceptance_rate": snap["spec_acceptance_rate"],
+            "dispatches_verify": snap["dispatches_verify"],
+            "tokens_per_decode_dispatch": (
+                snap["tokens_out"] / decode_d if decode_d else 0.0
+            ),
+        }
     slo.update_gauges(report)
     if args.dryrun and exporter is not None:
         try:
@@ -2060,6 +2102,29 @@ def build_parser() -> argparse.ArgumentParser:
         "the TTFT hit running decodes take from a long admission "
         "(0 = single-dispatch prefill)",
     )
+    sv.add_argument(
+        "--spec-k", type=int, default=0,
+        help="speculative decoding: draft tokens verified per decode "
+        "dispatch (0 = off). The host n-gram drafter proposes up to K "
+        "continuation tokens from each request's own prompt+generated "
+        "history; one fused verify dispatch scores all K+1 positions "
+        "in a single weight pass and commits the longest greedy-"
+        "consistent prefix — repetitive traffic lands several tokens "
+        "per dispatch, greedy output stays token-identical. Requires "
+        "--temperature 0",
+    )
+    sv.add_argument(
+        "--spec-ngram", type=int, default=3,
+        help="longest suffix n-gram the prompt-lookup drafter matches "
+        "(it backs off to shorter n, down to 1)",
+    )
+    sv.add_argument(
+        "--spec-min-accept", type=float, default=0.0,
+        help="per-request acceptance-rate floor: a request whose "
+        "measured draft acceptance stays under this after warmup "
+        "stops drafting (its verify lanes become plain decode). "
+        "0 = always draft",
+    )
     sv.add_argument("--temperature", type=float, default=0.0)
     sv.add_argument("--seed", type=int, default=0)
     sv.add_argument(
@@ -2159,6 +2224,24 @@ def build_parser() -> argparse.ArgumentParser:
     lg.add_argument(
         "--shared-prefix-len", type=int, default=12,
         help="tokens in each tenant's shared system-prompt template",
+    )
+    lg.add_argument(
+        "--repetition", type=float, default=0.0,
+        help="fraction of requests whose prompt is a short pattern "
+        "tiled to length — structured/templated traffic the "
+        "speculative n-gram drafter (`edl serve --spec-k`) can "
+        "predict (0 = off, byte-identical to pre-knob workloads)",
+    )
+    lg.add_argument(
+        "--repetition-len", type=int, default=4,
+        help="pattern period for --repetition prompts",
+    )
+    lg.add_argument(
+        "--spec-k", type=int, default=0,
+        help="serve the replay speculatively: draft tokens verified "
+        "per decode dispatch, as in `edl serve --spec-k` (0 = off). "
+        "The JSON report grows a `spec` section with drafted/accepted "
+        "counts and accepted-tokens-per-dispatch",
     )
     lg.add_argument(
         "--slots", type=int, default=0,

@@ -969,6 +969,224 @@ def prefill_paged(
     return logits, kc, vc
 
 
+# -- inference: speculative decoding (draft-verify) --------------------------
+#
+# Single-stream decode is weight-bandwidth-bound (BENCH_r05: int8 b=1
+# already at ~99.5% of peak HBM bandwidth), so the only remaining
+# latency lever is emitting MORE THAN ONE token per weight pass. The
+# verify programs below score K = D+1 query lanes per slot in one
+# dispatch — the pending token plus D host-drafted continuation
+# guesses — under length-K masked attention over the same KV cache the
+# horizon programs use. Greedy acceptance keeps the stream
+# token-identical to sequential decode: lane j's argmax is the true
+# next token after consuming lanes 0..j, so the longest draft prefix
+# matching argmax can be committed, plus the first non-matching argmax
+# as a bonus token (always >= 1 token per dispatch — a rejected draft
+# degrades to exactly one plain decode step, never worse).
+#
+# KV discipline: lane j writes its token's K/V at position pos+j
+# BEFORE the gather, so causal lanes see their own prefix. Rejected
+# lanes leave garbage at positions past the accepted run — safe under
+# the same overwrite-before-unmask invariant the horizon path uses:
+# the next dispatch re-writes every position it unmasks before reading
+# it (its lane 0 rewrites the new pending token's position, lane j its
+# own). Out-of-range writes (a row near the end of its cache) are
+# DROPPED (mode="drop"), matching the frozen-row behavior of
+# ``decode_step_slots`` at pos == S.
+
+
+def verify_step_slots(
+    params: Dict,
+    tok: jnp.ndarray,
+    draft: jnp.ndarray,
+    pos: jnp.ndarray,
+    active: jnp.ndarray,
+    rem: jnp.ndarray,
+    eosv: jnp.ndarray,
+    kc: jnp.ndarray,
+    vc: jnp.ndarray,
+    cfg: LlamaConfig,
+):
+    """One speculative draft–verify step over B independent KV slots:
+    score the pending token plus D drafted tokens in ONE dispatch and
+    commit the longest greedy-consistent prefix ON DEVICE.
+
+    tok [B] int32 (each slot's pending token, K/V not yet written);
+    draft [B, D] int32 host-proposed continuations, -1 = no draft in
+    that lane (-1 never matches an argmax, so a row with all -1 drafts
+    degrades to exactly one plain decode step — per-slot drafting is
+    disabled by feeding sentinels, membership never changes the
+    program); pos/rem/eosv [B] int32 and active [B] bool with the SAME
+    semantics as :func:`decode_horizon_slots`. kc/vc
+    [L, B, S, KV, hd]. Returns ``(outs [B, K], tok, pos, active, rem,
+    kc, vc)`` with K = D+1 — ``outs`` rows are the committed tokens in
+    emission order with -1 tails (frozen lanes, rejected drafts,
+    post-EOS lanes), the exact drain contract of the horizon programs.
+
+    Lane j embeds token j of ``[tok, draft]`` at position pos+j,
+    writes its K/V there, and attends causally to positions <= pos+j
+    (its own write and earlier lanes' writes land before the gather).
+    Lane j's argmax is therefore the true greedy successor of the
+    sequence ``... tok draft[0..j-1]`` — if every draft before lane j
+    matched argmax, lane j's argmax is exactly what sequential decode
+    would emit. Acceptance commits ``a`` = longest matching draft
+    prefix plus lane a's argmax as the bonus token (1 <= emitted <=
+    K), truncated by the row's remaining budget and cut AFTER the
+    first emitted EOS (the EOS itself is emitted, mid-verify, exactly
+    like the horizon's on-device EOS freeze). Frozen rows emit
+    nothing and keep their state; their lane-0 rewrite at the frozen
+    ``pos`` is idempotent and later lanes drop or are overwritten
+    before unmask. Greedy output is token-identical to sequential
+    :func:`generate` under EVERY acceptance outcome — the contract
+    tests/test_serving_spec.py pins."""
+    b, d = draft.shape
+    k = d + 1
+    h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    groups = h // kvh
+    s = kc.shape[2]
+    rows = jnp.arange(b)
+    # -1 sentinels embed as token 0; their lanes are never accepted
+    # (argmax >= 0 never equals -1), so the embedded value is dead
+    toks = jnp.concatenate([tok[:, None], jnp.maximum(draft, 0)], axis=1)
+    qpos = pos[:, None] + jnp.arange(k)[None, :]  # [B, K] absolute
+    x = jnp.take(params["embed"], toks, axis=0).astype(cfg.dtype)
+    # lane j sees cache positions <= pos+j — its own write included,
+    # garbage beyond masked exactly like the decode step's tail
+    qmask = (jnp.arange(s)[None, None, :] <= qpos[:, :, None])[
+        :, None, None, :, :
+    ]  # [B,1,1,K,S]
+    for i in range(cfg.n_layers):
+        lp = jax.tree_util.tree_map(lambda a: a[i], params["layers"])
+        dt = x.dtype
+        a = _rmsnorm(x, lp["ln1"], cfg.norm_eps)
+        q, knew, vnew = _qkv(cfg, a, lp, qpos)
+        # per-row K-lane scatter; rows[:, None] broadcasts against the
+        # [B, K] positions. Writes past S drop (frozen rows parked at
+        # the cache end), never clamp — a clamp would alias S-1.
+        kc = kc.at[i, rows[:, None], qpos].set(knew, mode="drop")
+        vc = vc.at[i, rows[:, None], qpos].set(vnew, mode="drop")
+        kci, vci = kc[i], vc[i]
+        qg = q.reshape(b, k, kvh, groups, hd)
+        scores = jnp.einsum("btkgd,bskd->bkgts", qg, kci) / np.sqrt(hd)
+        scores = jnp.where(qmask, scores, jnp.finfo(scores.dtype).min)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dt)
+        o = jnp.einsum("bkgts,bskd->btkgd", probs, vci).reshape(b, k, h * hd)
+        x = x + _matw(o, lp["wo"])
+        x = _mlp(cfg, x, lp)
+    x = _rmsnorm(x, params["ln_f"], cfg.norm_eps)
+    logits = _matw(x, params["lm_head"]).astype(jnp.float32)  # [B, K, V]
+    out = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, K]
+    return _spec_accept(tok, draft, out, pos, active, rem, eosv, kc, vc)
+
+
+def _spec_accept(tok, draft, out, pos, active, rem, eosv, kc, vc):
+    """On-device acceptance shared by the contiguous and paged verify
+    steps: commit the longest draft prefix matching greedy argmax plus
+    one bonus token, truncated by the remaining budget and cut after
+    the first emitted EOS. Pure slot-state bookkeeping — the K/V for
+    every committed position was already written by the verify lanes
+    (committed lane j's input token IS the matched draft)."""
+    b, d = draft.shape
+    k = d + 1
+    rows = jnp.arange(b)
+    # a = accepted draft prefix length: drafts match out shifted by one
+    # (out[:, j] is the successor of the sequence THROUGH draft[j-1])
+    match = (draft == out[:, :d]).astype(jnp.int32)
+    a = jnp.sum(jnp.cumprod(match, axis=1), axis=1)  # [B] in 0..D
+    idx = jnp.arange(k)[None, :]
+    emit = (
+        active[:, None]
+        & (idx < (a + 1)[:, None])  # accepted run + bonus token
+        & (idx < rem[:, None])  # budget truncation, same as horizon rem
+    )
+    is_eos = (eosv[:, None] >= 0) & (out == eosv[:, None])
+    eos_emitted = emit & is_eos
+    # lanes strictly AFTER the first emitted EOS are cut; the EOS
+    # itself is emitted (exclusive running count: cumsum minus self)
+    before = jnp.cumsum(eos_emitted.astype(jnp.int32), axis=1) - (
+        eos_emitted.astype(jnp.int32)
+    )
+    emit = emit & (before == 0)
+    e = jnp.sum(emit.astype(jnp.int32), axis=1)  # [B] emitted count
+    outs = jnp.where(emit, out, -1)
+    # the new pending token is the LAST emitted one (its K/V is not
+    # yet written — the next dispatch's lane 0 writes it, the same
+    # pending-token contract every decode program shares)
+    tok = jnp.where(e > 0, out[rows, jnp.clip(e - 1, 0, k - 1)], tok)
+    pos = pos + e
+    rem = rem - e
+    hit = jnp.any(eos_emitted & emit, axis=1)
+    active = active & ~hit & (rem > 0)
+    return outs, tok, pos, active, rem, kc, vc
+
+
+def verify_step_slots_paged(
+    params: Dict,
+    tok: jnp.ndarray,
+    draft: jnp.ndarray,
+    pos: jnp.ndarray,
+    active: jnp.ndarray,
+    rem: jnp.ndarray,
+    eosv: jnp.ndarray,
+    table: jnp.ndarray,
+    kc: jnp.ndarray,
+    vc: jnp.ndarray,
+    cfg: LlamaConfig,
+    block_size: int,
+):
+    """The paged twin of :func:`verify_step_slots`: K = D+1 query lanes
+    per row routed through the [B, M] block table, same on-device
+    acceptance. Lane writes target (table[row, (pos+j) // bs],
+    (pos+j) % bs); out-of-table lanes and uncovered positions route to
+    the scratch block (collisions there are never read). The engine
+    covers every position the accepted run can commit before
+    dispatching (``_ensure_cover`` sized to max(horizon, K)), so
+    committed lanes always land in mapped private blocks — uncovered
+    garbage from rejected lanes dies in scratch or is overwritten
+    before its position is ever unmasked."""
+    b, d = draft.shape
+    k = d + 1
+    h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    groups = h // kvh
+    bs = block_size
+    m = table.shape[1]
+    s = m * bs
+    rows = jnp.arange(b)
+    toks = jnp.concatenate([tok[:, None], jnp.maximum(draft, 0)], axis=1)
+    qpos = pos[:, None] + jnp.arange(k)[None, :]  # [B, K]
+    inb = qpos < s
+    # per-lane physical write targets; lanes past the table go to
+    # scratch like the decode step's frozen/stale rows
+    wblk = jnp.where(
+        inb, table[rows[:, None], jnp.clip(qpos // bs, 0, m - 1)], 0
+    )
+    woff = jnp.where(inb, qpos % bs, 0)
+    x = jnp.take(params["embed"], toks, axis=0).astype(cfg.dtype)
+    qmask = (jnp.arange(s)[None, None, :] <= qpos[:, :, None])[
+        :, None, None, :, :
+    ]
+    for i in range(cfg.n_layers):
+        lp = jax.tree_util.tree_map(lambda a: a[i], params["layers"])
+        dt = x.dtype
+        a = _rmsnorm(x, lp["ln1"], cfg.norm_eps)
+        q, knew, vnew = _qkv(cfg, a, lp, qpos)
+        kc = kc.at[i, wblk, woff].set(knew)
+        vc = vc.at[i, wblk, woff].set(vnew)
+        kci = kc[i][table].reshape(b, s, kvh, hd)
+        vci = vc[i][table].reshape(b, s, kvh, hd)
+        qg = q.reshape(b, k, kvh, groups, hd)
+        scores = jnp.einsum("btkgd,bskd->bkgts", qg, kci) / np.sqrt(hd)
+        scores = jnp.where(qmask, scores, jnp.finfo(scores.dtype).min)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dt)
+        o = jnp.einsum("bkgts,bskd->btkgd", probs, vci).reshape(b, k, h * hd)
+        x = x + _matw(o, lp["wo"])
+        x = _mlp(cfg, x, lp)
+    x = _rmsnorm(x, params["ln_f"], cfg.norm_eps)
+    logits = _matw(x, params["lm_head"]).astype(jnp.float32)
+    out = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return _spec_accept(tok, draft, out, pos, active, rem, eosv, kc, vc)
+
+
 def generate(
     params: Dict,
     tokens: jnp.ndarray,

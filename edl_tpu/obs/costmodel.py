@@ -338,6 +338,22 @@ class CostModel:
             hbm_bytes=horizon * step_bytes,
         )
 
+    def verify_block(self, b: int, k: int, s_pad: int) -> Cost:
+        """One speculative verify dispatch: ``k`` query lanes per row
+        (pending token + k-1 drafts) in ONE weight pass. FLOPs scale
+        with ``k`` like ``k`` decode steps, but HBM traffic is a
+        SINGLE step's — the weights and the full padded cache stream
+        once and feed every lane. That asymmetry is the whole point of
+        speculation on a bandwidth-bound decode: accepted-tokens/
+        dispatch > 1 multiplies tokens per byte moved."""
+        step_bytes = decode_step_bytes(
+            self.cfg, self.param_bytes, b, s_pad, self.kv_bytes_per_el
+        )
+        return Cost(
+            flops=k * b * decode_flops_per_token(self.cfg, s_pad),
+            hbm_bytes=step_bytes,
+        )
+
     def mfu(self, flops_per_s: float) -> float:
         return flops_per_s / self.peak.flops if self.peak.flops > 0 else 0.0
 

@@ -75,6 +75,20 @@ def summarize(fams: _Fams) -> List[str]:
             f"dispatches={disp:.0f}"
             + (f" disp/tok={disp / tokens:.3f}" if tokens else "")
         )
+        # speculation strip, only when the engine actually drafted:
+        # live acceptance rate + how many tokens each verify dispatch
+        # is landing (the figure --spec-k exists to raise)
+        drafted = _total(fams, "edl_serving_spec_drafted_total")
+        if drafted:
+            accepted = _total(fams, "edl_serving_spec_accepted_total")
+            vdisp = _total(fams, "edl_serving_dispatch_total",
+                           kind="verify")
+            lines.append(
+                f"         spec accept={accepted / drafted:.1%} "
+                f"drafted={drafted:.0f} accepted={accepted:.0f}"
+                + (f" tok/verify={(accepted + vdisp) / vdisp:.2f}"
+                   if vdisp else "")
+            )
         # the TTFT decomposition, when the engine exports it: where
         # the waiting actually happened (queue vs prefill vs block)
         if _total(fams, "edl_serving_queue_wait_seconds_count"):

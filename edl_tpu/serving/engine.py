@@ -119,6 +119,7 @@ from edl_tpu.obs import compilewatch
 from edl_tpu.obs import costmodel as _cm
 from edl_tpu.obs import memledger
 from edl_tpu.serving import paged as _paged
+from edl_tpu.serving import spec as _spec
 from edl_tpu.serving.metrics import ServingMetrics
 from edl_tpu.serving.scheduler import (
     AdmissionError,
@@ -317,6 +318,47 @@ def _copy_block_program(cfg: llama.LlamaConfig, nb: int, bs: int):
     return _memo(("blockcopy", cfg, nb, bs), make)
 
 
+def _verify_program(cfg: llama.LlamaConfig, b: int, s: int, d: int):
+    """(params, tok, draft [B, D], pos, active, rem, eosv, kc, vc) ->
+    (outs [B, D+1], tok, pos, active, rem, kc, vc). One speculative
+    draft–verify dispatch: D+1 query lanes per slot in ONE weight
+    pass, longest greedy-consistent draft prefix committed on device
+    (``llama.verify_step_slots``). Same donation contract as the block
+    program — kc/vc and the consumed slot-state vectors are donated;
+    eosv and the fresh host-built draft matrix are not."""
+
+    def make():
+        @partial(jax.jit, donate_argnums=(1, 3, 4, 5, 7, 8))
+        def run(params, tok, draft, pos, active, rem, eosv, kc, vc):
+            return llama.verify_step_slots(
+                params, tok, draft, pos, active, rem, eosv, kc, vc, cfg
+            )
+
+        return compilewatch.wrap(run, "serve.verify")
+
+    return _memo(("verify", cfg, b, s, d), make)
+
+
+def _verify_program_paged(
+    cfg: llama.LlamaConfig, b: int, nb: int, m: int, bs: int, d: int
+):
+    """The paged twin of :func:`_verify_program`: same carries plus
+    the [B, M] block table (read-only, NOT donated, same as the paged
+    block program)."""
+
+    def make():
+        @partial(jax.jit, donate_argnums=(1, 3, 4, 5, 8, 9))
+        def run(params, tok, draft, pos, active, rem, eosv, table, kc, vc):
+            return llama.verify_step_slots_paged(
+                params, tok, draft, pos, active, rem, eosv, table, kc, vc,
+                cfg, block_size=bs,
+            )
+
+        return compilewatch.wrap(run, "serve.verify")
+
+    return _memo(("verify-paged", cfg, b, nb, m, bs, d), make)
+
+
 @dataclass
 class _Slot:
     """Host-side state of one occupied KV slot. The device holds the
@@ -393,6 +435,9 @@ class ContinuousBatchingEngine:
         pool_blocks: Optional[int] = None,
         prefix_cache: bool = False,
         prefill_chunk: int = 0,
+        spec_k: int = 0,
+        spec_ngram: int = 3,
+        spec_min_accept: float = 0.0,
         clock=time.monotonic,
     ):
         if max_slots < 1:
@@ -405,6 +450,22 @@ class ContinuousBatchingEngine:
             raise ValueError(
                 f"max_recoveries must be >= 0, got {max_recoveries}"
             )
+        if spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {spec_k}")
+        if spec_k > 0:
+            # speculation is greedy-only: acceptance compares drafts to
+            # argmax, and a sampled stream has no "the" next token to
+            # match — fail loudly instead of silently changing the
+            # sampling distribution
+            if temperature > 0:
+                raise ValueError(
+                    "spec_k > 0 requires greedy decoding "
+                    f"(temperature 0), got temperature {temperature}"
+                )
+            if spec_ngram < 1:
+                raise ValueError(
+                    f"spec_ngram must be >= 1, got {spec_ngram}"
+                )
         # paged KV mode (block_size > 0): the cache is a pool of
         # fixed-size blocks addressed through per-slot block tables —
         # HBM scales with RESIDENT tokens, not slots x max_len, and
@@ -493,6 +554,22 @@ class ContinuousBatchingEngine:
         # `horizon` steps over the full padded cache (program cost)
         self._block_cost = self._cost.decode_block(
             max_slots, horizon, max_len
+        )
+        # speculative draft–verify (spec_k > 0): each verify dispatch
+        # scores spec_k host-drafted tokens + the pending token in one
+        # weight pass. Drafting is on-host n-gram prompt lookup over
+        # prompt + generated; the policy disables drafting per request
+        # when measured acceptance can't beat plain horizon decode.
+        self.spec_k = int(spec_k)
+        self.spec_ngram = int(spec_ngram)
+        self.spec_min_accept = float(spec_min_accept)
+        self._spec_policy = (
+            _spec.SpecPolicy(min_accept=self.spec_min_accept)
+            if self.spec_k > 0 else None
+        )
+        self._verify_cost = (
+            self._cost.verify_block(max_slots, self.spec_k + 1, max_len)
+            if self.spec_k > 0 else None
         )
         self._ledger.register(self._ledger_owner, "params", pbytes, "params")
         weakref.finalize(self, self._ledger.release_owner, self._ledger_owner)
@@ -745,13 +822,50 @@ class ContinuousBatchingEngine:
             1 for s in self._slots if s is not None and s.pf_next is None
         )
         if decoding:
-            self._dispatch_block()
-            # double buffer: block k+1 is now on device; drain block k
-            # (bookkeeping overlaps the device work, no idle bubble)
-            while len(self._inflight) > 1:
-                emitted += self._drain_one()
+            if self.spec_k > 0:
+                emitted += self._step_spec()
+            else:
+                self._dispatch_block()
+                # double buffer: block k+1 is now on device; drain
+                # block k (bookkeeping overlaps the device work, no
+                # idle bubble)
+                while len(self._inflight) > 1:
+                    emitted += self._drain_one()
         else:
             emitted += self._drain_all()
+        return emitted
+
+    def _step_spec(self) -> int:
+        """One speculative iteration: draft per decoding slot from its
+        committed ``prompt + generated`` history, dispatch ONE verify
+        step over every slot (slots with no usable draft ride along as
+        -1 sentinels = one plain decode step), and drain synchronously.
+
+        Spec mode trades the double buffer for drafting freshness: the
+        drafter needs block k's committed tokens to propose block
+        k+1's continuation, so each dispatch syncs before the next —
+        the dispatch amortization now comes from accepted tokens per
+        verify, not from pipelining. When NO slot drafts (nothing
+        repeats yet, or the policy disabled everyone) the step falls
+        back to a plain horizon block, so a non-repetitive stream pays
+        the horizon path's cost, one sync earlier."""
+        emitted = self._drain_all()
+        drafts: Dict[int, List[int]] = {}
+        for i, sl in enumerate(self._slots):
+            if sl is None or sl.pf_next is not None:
+                continue
+            if not self._spec_policy.should_draft(sl.rid):
+                continue
+            row = _spec.draft_ngram(
+                sl.prompt + sl.generated, self.spec_ngram, self.spec_k
+            )
+            if row:
+                drafts[i] = row
+        if drafts:
+            self._dispatch_verify(drafts)
+        else:
+            self._dispatch_block()
+        emitted += self._drain_all()
         return emitted
 
     def run(self, max_steps: Optional[int] = None) -> Dict[str, RequestResult]:
@@ -930,7 +1044,79 @@ class ContinuousBatchingEngine:
             i: s.rid for i, s in enumerate(self._slots)
             if s is not None and s.pf_next is None
         }
-        self._inflight.append((toks, self.clock(), members))
+        self._inflight.append(
+            (toks, self.clock(), members, self._block_cost, None)
+        )
+
+    def _dispatch_verify(self, drafts: Dict[int, List[int]]) -> None:
+        """One speculative verify dispatch: assemble the [B, D] draft
+        matrix (-1 sentinel lanes for undrafted/absent slots — a
+        sentinel row is exactly one plain decode step, so membership
+        and per-slot disable never change the program) and run the
+        verify program over every slot. Same dispatch discipline as
+        ``_dispatch_block``: donated carries, ``_assert_donated``
+        probe, ``serve.dispatch`` chaos site — a crash here recovers
+        identically (``generated`` holds only drained tokens, so the
+        replay's committed truth is complete mid-speculation)."""
+        d = self.spec_k
+        dm = np.full((self.max_slots, d), -1, np.int32)
+        drafted: Dict[int, int] = {}
+        for i, row in drafts.items():
+            row = row[:d]
+            dm[i, :len(row)] = row
+            drafted[i] = len(row)
+        table = None
+        if self._paged:
+            # same pre-dispatch coverage walk as the block path;
+            # _ensure_cover sizes the window to max(horizon, K) so
+            # every position an accepted run can commit is mapped
+            for i, sl in enumerate(self._slots):
+                if sl is not None and sl.pf_next is None:
+                    self._ensure_cover(i)
+            tbl = np.zeros((self.max_slots, self._m), np.int32)
+            for i, sl in enumerate(self._slots):
+                if sl is not None and sl.pf_next is None:
+                    tbl[i] = self._tables[i]
+            table = jnp.asarray(tbl)
+        old = (self._dtok, self._dpos, self._dact, self._drem,
+               self._kc, self._vc)
+        rids = [s.rid for s in self._slots if s is not None]
+        with tracing.span("serving.dispatch", horizon=self.horizon,
+                          rids=rids, spec_k=d):
+            if self._paged:
+                prog = _verify_program_paged(
+                    self.cfg, self.max_slots, self.pool_blocks,
+                    self._m, self.block_size, d,
+                )
+                (toks, self._dtok, self._dpos, self._dact, self._drem,
+                 self._kc, self._vc) = prog(
+                    self.params, old[0], jnp.asarray(dm), old[1],
+                    old[2], old[3], self._deos, table, old[4], old[5],
+                )
+            else:
+                prog = _verify_program(
+                    self.cfg, self.max_slots, self.max_len, d
+                )
+                (toks, self._dtok, self._dpos, self._dact, self._drem,
+                 self._kc, self._vc) = prog(
+                    self.params, old[0], jnp.asarray(dm), old[1],
+                    old[2], old[3], self._deos, old[4], old[5],
+                )
+        self.metrics.on_dispatch("verify")
+        # edl: no-lint[donation-safety] deliberate is_deleted() probe of the donation contract
+        self._assert_donated(*old)
+        flight.emit("serve.block", active=self.active_slots,
+                    horizon=self.horizon, spec_k=d)
+        # chaos site: same worst case as the block dispatch — donated
+        # inputs dead, accepted tokens only on device
+        faults.fault_point("serve.dispatch")
+        members = {
+            i: s.rid for i, s in enumerate(self._slots)
+            if s is not None and s.pf_next is None
+        }
+        self._inflight.append(
+            (toks, self.clock(), members, self._verify_cost, drafted)
+        )
 
     def _drain_one(self) -> int:
         """Sync the OLDEST in-flight block's [B, H] token matrix and
@@ -943,7 +1129,9 @@ class ContinuousBatchingEngine:
             "serving.drain",
             rids=[s.rid for s in self._slots if s is not None],
         ):
-            blk, t_dispatch, members = self._inflight.popleft()
+            blk, t_dispatch, members, cost, drafted = (
+                self._inflight.popleft()
+            )
             # chaos site: the popped block is lost on a crash here —
             # its tokens exist only on device, recovery must regenerate
             faults.fault_point("serve.drain")
@@ -952,14 +1140,16 @@ class ContinuousBatchingEngine:
         # the latency decomposition (end-to-end as the host saw it)
         now = self.clock()
         self.metrics.on_block(now - t_dispatch)
-        # roofline accounting: the block's analytic cost over its busy
-        # window, clipped against the previous drain so the double
-        # buffer cannot charge overlapped device time twice
+        # roofline accounting: the block's analytic cost (horizon or
+        # verify, stamped at dispatch) over its busy window, clipped
+        # against the previous drain so the double buffer cannot
+        # charge overlapped device time twice
         self._eff.observe(
-            "decode", self._block_cost, now - max(self._t_eff_last, t_dispatch)
+            "decode", cost, now - max(self._t_eff_last, t_dispatch)
         )
         self._t_eff_last = now
         emitted = 0
+        spec_drafted = spec_accepted = 0
         for i in range(self.max_slots):
             sl = self._slots[i]
             if sl is None:
@@ -986,8 +1176,22 @@ class ContinuousBatchingEngine:
             if n:
                 self.metrics.on_tokens(sl.rid, n)
                 emitted += n
+            if drafted is not None and drafted.get(i, 0) > 0:
+                # verify-block bookkeeping: of this row's emitted run,
+                # everything but the bonus token was an accepted draft
+                # (EOS/budget truncation included — the device emit
+                # mask and this host replay agree lane for lane)
+                nd = drafted[i]
+                acc = max(0, n - 1)
+                spec_drafted += nd
+                spec_accepted += acc
+                self._spec_policy.observe(sl.rid, nd, acc)
+                flight.emit("serve.verify", rid=sl.rid, drafted=nd,
+                            accepted=acc, emitted=n)
             if outcome:
                 self._finish(i, outcome)
+        if drafted is not None:
+            self.metrics.on_spec(spec_drafted, spec_accepted)
         return emitted
 
     def _drain_all(self) -> int:
@@ -1435,10 +1639,14 @@ class ContinuousBatchingEngine:
         device and are masked on read, so they need no coverage."""
         sl = self._slots[i]
         t0 = len(sl.prompt) + len(sl.generated)
+        # the per-dispatch advance bound: a horizon block moves a lane
+        # up to `horizon` positions, a verify dispatch up to spec_k+1
+        # (full acceptance + bonus) — cover whichever this engine runs
+        adv = max(self.horizon, self.spec_k + 1)
         need = min(
             self.max_len,
             len(sl.prompt) + sl.max_new,
-            t0 + self.horizon * (len(self._inflight) + 1),
+            t0 + adv * (len(self._inflight) + 1),
         )
         tbl = self._tables[i]
         for j in range(_paged.blocks_for(need, self.block_size)):
@@ -1525,6 +1733,8 @@ class ContinuousBatchingEngine:
 
     def _finish(self, slot: int, outcome: str) -> None:
         sl = self._slots[slot]
+        if self._spec_policy is not None:
+            self._spec_policy.forget(sl.rid)
         self.results[sl.rid] = RequestResult(
             rid=sl.rid, tokens=list(sl.generated), outcome=outcome
         )

@@ -119,6 +119,15 @@ class WorkloadSpec:
     # byte-identical to pre-knob builds (the CI cmp gate).
     shared_prefix_frac: float = 0.0
     shared_prefix_len: int = 12
+    # repetitive-prompt traffic (speculative-decoding measurement):
+    # with probability ``repetition_frac`` a request's prompt is
+    # REPLACED by a short random pattern of ``repetition_len`` tokens
+    # tiled to the drawn prompt length — structured/templated traffic
+    # the n-gram drafter can actually predict. 0.0 (the default) draws
+    # NOTHING extra from the rng: pre-knob workloads stay
+    # byte-identical (the CI cmp gate).
+    repetition_frac: float = 0.0
+    repetition_len: int = 4
     tenants: Tuple[TenantSpec, ...] = field(default_factory=default_tenants)
     classes: Tuple[SLOClass, ...] = field(default_factory=default_classes)
 
@@ -246,6 +255,14 @@ def build(spec: WorkloadSpec) -> List[GenRequest]:
         raise ValueError(
             f"shared_prefix_len must be >= 1, got {spec.shared_prefix_len}"
         )
+    if not 0.0 <= spec.repetition_frac <= 1.0:
+        raise ValueError(
+            f"repetition_frac must be in [0, 1], got {spec.repetition_frac}"
+        )
+    if spec.repetition_len < 1:
+        raise ValueError(
+            f"repetition_len must be >= 1, got {spec.repetition_len}"
+        )
     rng = np.random.RandomState(spec.seed)
     arrivals = _arrival_times(spec, rng)
     # per-tenant system-prompt templates, drawn ONCE and only when the
@@ -273,6 +290,14 @@ def build(spec: WorkloadSpec) -> List[GenRequest]:
                 # identical-template requests still diverge
                 k = min(len(tpl), max(plen - 1, 0))
                 prompt[:k] = tpl[:k]
+        if spec.repetition_frac > 0:
+            # same draw-order rule as shared_prefix: the extra draws
+            # sit behind the gate, AFTER every existing per-request
+            # draw, so frac=0 builds reproduce byte-for-byte
+            if float(rng.rand()) < spec.repetition_frac:
+                period = min(spec.repetition_len, plen)
+                pat = [int(x) for x in rng.randint(0, spec.vocab, period)]
+                prompt = (pat * (plen // period + 1))[:plen]
         c = cmap[t.slo_class]
         reqs.append(
             GenRequest(

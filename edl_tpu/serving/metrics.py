@@ -190,6 +190,24 @@ class ServingMetrics:
             "fused decode block wall time (dispatch -> drain)",
             buckets=_LATENCY_BUCKETS,
         )
+        # speculative decoding: drafted vs accepted draft tokens (the
+        # acceptance-rate numerator/denominator) + a live-rate gauge.
+        # Counters so fleet aggregation and PromQL rate() work; the
+        # gauge is the at-a-glance figure `edl top` renders.
+        self.spec_drafted = 0
+        self.spec_accepted = 0
+        self._m_spec_drafted = r.counter(
+            "edl_serving_spec_drafted_total",
+            "draft tokens proposed to verify dispatches",
+        )
+        self._m_spec_accepted = r.counter(
+            "edl_serving_spec_accepted_total",
+            "draft tokens accepted by greedy verification",
+        )
+        self._m_spec_rate = r.gauge(
+            "edl_serving_spec_acceptance_rate",
+            "cumulative accepted/drafted ratio of speculative decoding",
+        )
         self._m_queue = r.gauge(
             "edl_serving_queue_depth", "requests waiting for a KV slot"
         )
@@ -297,6 +315,23 @@ class ServingMetrics:
         block, ``prefill`` = an admission insert)."""
         self.dispatches[kind] += 1
         self._m_dispatch.inc(kind=kind)
+
+    def on_spec(self, drafted: int, accepted: int) -> None:
+        """One drained verify block's speculation outcome: ``drafted``
+        draft tokens went in, ``accepted`` matched greedy argmax.
+        (Bonus tokens — the one guaranteed emission per dispatch — are
+        deliberately NOT counted here: acceptance rate measures the
+        DRAFTER, and counting freebies would floor it at 1/K.)"""
+        if drafted <= 0 and accepted <= 0:
+            return
+        self.spec_drafted += drafted
+        self.spec_accepted += accepted
+        if drafted > 0:
+            self._m_spec_drafted.inc(drafted)
+        if accepted > 0:
+            self._m_spec_accepted.inc(accepted)
+        if self.spec_drafted > 0:
+            self._m_spec_rate.set(self.spec_accepted / self.spec_drafted)
 
     def on_block(self, seconds: float) -> None:
         """One fused horizon block's dispatch→drain wall time — the
@@ -451,6 +486,16 @@ class ServingMetrics:
             "agg_tokens_per_s": self.tokens_out / busy if busy > 0 else 0.0,
             "dispatches_decode": float(self.dispatches["decode"]),
             "dispatches_prefill": float(self.dispatches["prefill"]),
+            "dispatches_verify": float(self.dispatches["verify"]),
+            # speculation: drafted/accepted totals + cumulative
+            # acceptance rate (0 when speculation never ran)
+            "spec_drafted": float(self.spec_drafted),
+            "spec_accepted": float(self.spec_accepted),
+            "spec_acceptance_rate": (
+                self.spec_accepted / self.spec_drafted
+                if self.spec_drafted
+                else 0.0
+            ),
             # the fused-horizon efficiency headline: device dispatches
             # per generated token (1/H + admission overhead when the
             # pipeline is healthy; ~1.0 means per-token dispatch)

@@ -99,6 +99,15 @@ METRICS: Dict[str, MetricSpec] = {
     "serving_prefix_hit_ttft_ms": MetricSpec(
         -1, 0.30, "serving_paged_config"
     ),
+    # speculative decoding rungs: per-dispatch amplification is a
+    # deterministic count ratio (tight), wall-clock b=1 rate rides the
+    # usual serving timing noise
+    "serving_spec_accepted_per_dispatch": MetricSpec(
+        +1, 0.10, "serving_spec_config"
+    ),
+    "serving_spec_b1_tokens_per_sec": MetricSpec(
+        +1, 0.15, "serving_spec_config"
+    ),
     # elastic protocol (lower is better; tunneled-chip timing noise)
     "reshard_stall_s": MetricSpec(-1, 0.25),
     "reshard_stall_host_fallback_s": MetricSpec(-1, 0.25),

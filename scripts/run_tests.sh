@@ -240,6 +240,90 @@ done
 rm -rf "$FLDIR"
 t11=$(date +%s)
 echo "== phase 11 done in $((t11 - t10))s (rc=$rc11) =="
-echo "== total $((t11 - t0))s =="
 
-[ "$rc0" -eq 0 ] && [ "$rc1" -eq 0 ] && [ "$rc2" -eq 0 ] && [ "$rc3" -eq 0 ] && [ "$rc4" -eq 0 ] && [ "$rc5" -eq 0 ] && [ "$rc6" -eq 0 ] && [ "$rc7" -eq 0 ] && [ "$rc8" -eq 0 ] && [ "$rc9" -eq 0 ] && [ "$rc10" -eq 0 ] && [ "$rc11" -eq 0 ]
+echo "== phase 12: speculative decoding gate (acceptance + identity + zero overhead) =="
+# the draft-verify loop's three CI contracts, on CPU:
+#   (a) CLI surface: `edl loadgen --dryrun --repetition 0.8 --spec-k 4`
+#       on the repetitive workload must report acceptance > 15% and
+#       > 1.3 emitted tokens per decode-phase dispatch — speculation
+#       that stops landing tokens fails CI, not just the bench;
+#   (b) exact greedy token identity: the speculative engine must
+#       produce byte-identical streams to the non-speculative engine
+#       on a mixed repetitive/adversarial workload with mid-stream
+#       joins (the correctness contract of doc/usage.md 4.4.1);
+#   (c) --spec-k 0 is ZERO overhead: identical tokens AND identical
+#       dispatch counters to an engine built without spec args, and
+#       the H8-vs-H1 dispatch-amortization figure phase 4 pins is
+#       bit-for-bit unchanged.
+SPDIR="${TMPDIR:-/tmp}/edl-spec.$$"
+rm -rf "$SPDIR"; mkdir -p "$SPDIR"
+rc12=0
+JAX_PLATFORMS=cpu python -m edl_tpu.cli loadgen --dryrun --seed 3 \
+    --requests 12 --repetition 0.8 --repetition-len 3 --spec-k 4 --json \
+    > "$SPDIR/spec.json" || rc12=1
+python - "$SPDIR/spec.json" <<'PY' || rc12=1
+import json, sys
+r = json.load(open(sys.argv[1]))
+sp = r["spec"]
+assert sp["spec_k"] == 4 and sp["drafted"] > 0, sp
+assert sp["acceptance_rate"] > 0.15, f"spec acceptance too low: {sp}"
+assert sp["tokens_per_decode_dispatch"] > 1.3, \
+    f"spec amplification too low: {sp}"
+print(f"spec loadgen OK: accept={sp['acceptance_rate']:.1%} "
+      f"tok/dispatch={sp['tokens_per_decode_dispatch']:.3f} "
+      f"verify_dispatches={sp['dispatches_verify']}")
+PY
+JAX_PLATFORMS=cpu python - <<'PY' || rc12=1
+import jax
+from edl_tpu.models import llama
+from edl_tpu.obs.metrics import MetricsRegistry
+from edl_tpu.serving.engine import ContinuousBatchingEngine
+from edl_tpu.serving.metrics import ServingMetrics
+
+cfg = llama.LlamaConfig.tiny()
+params = llama.init_params(jax.random.PRNGKey(0), cfg)
+# mixed workload: repetitive prompts the drafter locks onto +
+# adversarial random ones it cannot, joining mid-stream
+reqs = [([1, 2, 3, 4] * 3, 17), ([5, 9] * 4, 13), ([7, 3, 11], 11),
+        ([2] * 8, 15), ([10, 20, 30, 40, 50], 9), ([6, 6, 7, 7], 12)]
+
+def run(h, **kw):
+    m = ServingMetrics(registry=MetricsRegistry())
+    eng = ContinuousBatchingEngine(
+        params, cfg, max_slots=3, max_len=96, horizon=h, metrics=m, **kw)
+    for i, (p, n) in enumerate(reqs[:3]):
+        eng.submit(f"r{i}", p, n)
+    eng.step()
+    for i, (p, n) in enumerate(reqs[3:], start=3):
+        eng.submit(f"r{i}", p, n)
+    eng.run()
+    toks = {r: list(eng.results[r].tokens) for r in eng.results}
+    return toks, m.snapshot()
+
+base, bsnap = run(1)
+spec, ssnap = run(1, spec_k=4, spec_ngram=3)
+assert spec == base, "speculative tokens diverge from greedy baseline"
+assert ssnap["dispatches_verify"] >= 1 and ssnap["spec_accepted"] >= 1, ssnap
+off, osnap = run(1, spec_k=0)
+assert off == base, "--spec-k 0 tokens diverge"
+for k in ("dispatches_decode", "dispatches_prefill", "dispatches_verify",
+          "tokens_out", "dispatches_per_token"):
+    assert osnap[k] == bsnap[k], f"--spec-k 0 overhead on {k}: " \
+        f"{osnap[k]} vs {bsnap[k]}"
+assert osnap["spec_drafted"] == 0, osnap
+# the H8-vs-H1 amortization figure phase 4 pins must be unchanged
+_, b1 = run(1); _, b8 = run(8)
+_, o1 = run(1, spec_k=0); _, o8 = run(8, spec_k=0)
+ratio_b = b1["dispatches_per_token"] / b8["dispatches_per_token"]
+ratio_o = o1["dispatches_per_token"] / o8["dispatches_per_token"]
+assert ratio_o == ratio_b, f"H8-vs-H1 figure moved: {ratio_o} vs {ratio_b}"
+print(f"spec identity OK: {len(base)} streams identical, "
+      f"accepted={ssnap['spec_accepted']:.0f}; spec-k 0 zero-overhead, "
+      f"H8-vs-H1 dispatch reduction {ratio_b:.2f}x unchanged")
+PY
+rm -rf "$SPDIR"
+t12=$(date +%s)
+echo "== phase 12 done in $((t12 - t11))s (rc=$rc12) =="
+echo "== total $((t12 - t0))s =="
+
+[ "$rc0" -eq 0 ] && [ "$rc1" -eq 0 ] && [ "$rc2" -eq 0 ] && [ "$rc3" -eq 0 ] && [ "$rc4" -eq 0 ] && [ "$rc5" -eq 0 ] && [ "$rc6" -eq 0 ] && [ "$rc7" -eq 0 ] && [ "$rc8" -eq 0 ] && [ "$rc9" -eq 0 ] && [ "$rc10" -eq 0 ] && [ "$rc11" -eq 0 ] && [ "$rc12" -eq 0 ]

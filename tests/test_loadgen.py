@@ -104,6 +104,55 @@ def test_shared_prefix_validation():
         )
 
 
+def test_repetition_zero_is_byte_identical_to_default():
+    """Same off-position contract as shared_prefix: repetition_frac=0
+    draws nothing extra, so pre-knob workload bytes are preserved (the
+    CI cmp gate)."""
+    base = loadgen.WorkloadSpec(seed=9, n_requests=32, rate_rps=16.0)
+    off = loadgen.WorkloadSpec(
+        seed=9, n_requests=32, rate_rps=16.0, repetition_frac=0.0
+    )
+    assert (
+        loadgen.workload_jsonl(loadgen.build(base))
+        == loadgen.workload_jsonl(loadgen.build(off))
+    )
+
+
+def test_repetition_tiles_prompts():
+    """With the knob on, ~frac of the prompts become a short pattern
+    tiled to the drawn length — the traffic shape the n-gram drafter
+    can predict — and the build stays seed-deterministic."""
+    spec = loadgen.WorkloadSpec(
+        seed=9, n_requests=200, rate_rps=16.0,
+        repetition_frac=0.5, repetition_len=4,
+    )
+    reqs = loadgen.build(spec)
+    assert loadgen.workload_jsonl(loadgen.build(spec)) == (
+        loadgen.workload_jsonl(reqs)
+    )
+
+    def is_tiled(p, period):
+        return len(p) > period and all(
+            p[i] == p[i % period] for i in range(len(p))
+        )
+
+    tiled = sum(1 for r in reqs if is_tiled(r.prompt, 4))
+    eligible = sum(1 for r in reqs if len(r.prompt) > 4)
+    assert 0.3 <= tiled / eligible <= 0.7, (tiled, eligible)
+    # prompt lengths and vocab bounds are untouched by the rewrite
+    for r in reqs:
+        assert all(0 <= t < spec.vocab for t in r.prompt)
+
+
+def test_repetition_validation():
+    with pytest.raises(ValueError):
+        loadgen.build(loadgen.WorkloadSpec(repetition_frac=-0.1))
+    with pytest.raises(ValueError):
+        loadgen.build(
+            loadgen.WorkloadSpec(repetition_frac=0.5, repetition_len=0)
+        )
+
+
 def test_workload_shape_and_bounds():
     spec = loadgen.WorkloadSpec(seed=0, n_requests=64, rate_rps=16.0)
     reqs = loadgen.build(spec)
