@@ -411,6 +411,62 @@ def _p2p_bench() -> dict:
     }
 
 
+def _elasticity_bench() -> dict:
+    """Train⇄serve elasticity rung: one full run of the chip-handover
+    demo (scripts/exp_elasticity.py — broker + controller + live
+    trainer + real warm-started replica fleet over two diurnal cycles)
+    in a subprocess, publishing the printed ``ELASTICITY_MEASURE``
+    figures:
+
+    - ``elasticity_handover_stall_s`` — worst traffic-stopping trainer
+      reshard inside a handover (the lease-driven twin of
+      ``reshard_stall_s``);
+    - ``elasticity_grant_ready_s`` — chip grant → replica READY ramp,
+      dominated by the warm spawn (process boot + p2p pull + compile);
+    - ``elasticity_warm_fetch_s`` / ``elasticity_cold_load_s`` — the
+      p2p weight pull vs the cold export+load disk round trip for the
+      same tree (the satellite comparison; cold rides ungated).
+
+    A failed or timed-out demo publishes ``-1.0`` sentinels — the perf
+    gate reports them as skipped, never as a silent pass."""
+    import os
+    import subprocess
+    import sys as _sys
+
+    out = {
+        "elasticity_handover_stall_s": -1.0,
+        "elasticity_grant_ready_s": -1.0,
+        "elasticity_warm_fetch_s": -1.0,
+        "elasticity_cold_load_s": -1.0,
+        "elasticity_config": "pool8/train6/cpr2/h48",
+    }
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "scripts", "exp_elasticity.py",
+    )
+    try:
+        res = subprocess.run(
+            [_sys.executable, script, "--dryrun", "--seed", "0"],
+            capture_output=True, text=True, timeout=900,
+        )
+    except subprocess.TimeoutExpired:
+        return out
+    if res.returncode != 0:
+        return out
+    for line in res.stdout.splitlines():
+        if not line.startswith("ELASTICITY_MEASURE "):
+            continue
+        for part in line.split()[1:]:
+            k, _, v = part.partition("=")
+            key = f"elasticity_{k}" if not k.startswith("elasticity") else k
+            if key.removeprefix("elasticity_") in (
+                "handover_stall_s", "grant_ready_s", "warm_fetch_s",
+                "cold_load_s",
+            ):
+                out[key] = float(v)
+    return out
+
+
 def _peak_hbm_bw(device) -> float:
     """Per-chip HBM bandwidth (bytes/s) — the decode roofline
     denominator, from the shared peak table (obs/costmodel.py).
@@ -1044,6 +1100,7 @@ def main() -> None:
     llama_metrics.update(_llama_paged_bench())
     llama_metrics.update(_llama_spec_bench())
     llama_metrics.update(_p2p_bench())
+    llama_metrics.update(_elasticity_bench())
 
     print(
         json.dumps(

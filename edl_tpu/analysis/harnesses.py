@@ -486,6 +486,71 @@ def _flight_recorder() -> None:
     assert sum(counts.values()) == 6, counts
 
 
+def _lease_broker(mutate: bool) -> None:
+    import threading
+
+    from edl_tpu.elasticity.broker import (
+        FREED,
+        ChipLeaseBroker,
+        LeaseError,
+    )
+    from edl_tpu.obs.metrics import MetricsRegistry
+
+    b = ChipLeaseBroker(6, registry=MetricsRegistry())
+    # pre-scheduler setup: a train lease, and a serving holder whose
+    # recall has been sent but will never be acked (crash candidate)
+    train = b.grant("train:job", 2)
+    stuck = b.grant("serve:x", 2)
+    b.recall(stuck.lease_id)
+    if mutate:
+        b._lock = NullLock()
+    instrument(b, ["_epoch", "_free"], name="ChipLeaseBroker")
+    b._leases = TrackedDict("ChipLeaseBroker._leases", b._leases)
+
+    # the three parties that share the table in production: the
+    # controller granting serving slices, the handover path recalling
+    # and freeing the train lease (with an idempotent retry), and the
+    # supervisor settling a crashed holder
+    def granter() -> None:
+        for i in range(3):
+            try:
+                b.grant(f"serve:g{i}", 1)
+            except LeaseError:
+                pass  # pool exhausted: a legal outcome, not a race
+            checkpoint("grant-gap")
+
+    def recaller() -> None:
+        b.recall(train.lease_id)
+        checkpoint("recall-gap")
+        b.recall(train.lease_id)  # retried RPC: must be a no-op
+        b.free(train.lease_id)
+
+    def crasher() -> None:
+        checkpoint("crash-gap")
+        b.holder_crashed("serve:x")
+
+    t1 = threading.Thread(target=granter, name="grant")
+    t2 = threading.Thread(target=recaller, name="recall")
+    t3 = threading.Thread(target=crasher, name="crash")
+    t1.start()
+    t2.start()
+    t3.start()
+    t1.join()
+    t2.join()
+    t3.join()
+    # conservation: chips under live leases + free pool == inventory,
+    # in every explored interleaving
+    assert b.check_conservation(), (
+        b.free_chips, [(l.lease_id, l.state, l.chips) for l in b.snapshot()]
+    )
+    # epochs are strictly increasing in grant order
+    epochs = sorted(l.epoch for l in b.snapshot())
+    assert len(set(epochs)) == len(epochs), epochs
+    # both terminal transitions landed exactly once
+    assert b.get(train.lease_id).state == FREED
+    assert b.get(stuck.lease_id).state == FREED
+
+
 def _kube_rv() -> None:
     import threading
 
@@ -563,6 +628,10 @@ HARNESSES: Dict[str, Harness] = {
         _mk("flight-recorder", lambda: _flight_recorder(),
             "FlightRecorder ring: seq/dropped/counts invariants under "
             "two emitters and a reader"),
+        _mk("lease-broker", lambda: _lease_broker(False),
+            "elasticity ChipLeaseBroker: granter vs recall/free vs "
+            "holder-crash under _lock (expect race-free; conservation "
+            "+ epoch monotonicity at quiescence)"),
         _mk("kube-rv", lambda: _kube_rv(),
             "KubeJobSource relist/close vs watch thread: witnesses the "
             "baselined _rv hand-off and the no-lint'd _stop flip",
@@ -590,6 +659,12 @@ HARNESSES: Dict[str, Harness] = {
             # unlike mut-conn-close the lockless map rarely CRASHES —
             # the HB race report on the shared dict is the evidence
             expect_keys=["ReplicaTable._replicas"],
+            mutation=True),
+        _mk("mut-lease-broker", lambda: _lease_broker(True),
+            "MUTATION: ChipLeaseBroker._lock removed — grant/recall/"
+            "crash race on the lease table and the free-chip count",
+            expect_evidence=True,
+            expect_keys=["ChipLeaseBroker"],
             mutation=True),
     ]
 }
@@ -626,6 +701,14 @@ STATIC_XREF: List[Dict[str, Any]] = [
                  "drain/evict share the replica map (PR 13; _lock)",
         "guarded": "router-table",
         "mutated": "mut-router-table",
+    },
+    {
+        "site": "edl_tpu/elasticity/broker.py:ChipLeaseBroker._leases",
+        "claim": "controller grants, handover recall/free, and crash "
+                 "settlement share the lease table + free count "
+                 "(PR 15; _lock)",
+        "guarded": "lease-broker",
+        "mutated": "mut-lease-broker",
     },
     {
         "site": "edl_tpu/cluster/kube.py:KubeJobSource._rv "

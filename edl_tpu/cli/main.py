@@ -1373,7 +1373,33 @@ def _run_fleet_replica(args) -> int:
     from edl_tpu.serving.scheduler import RequestQueue
 
     params = cfg = None
-    if args.dryrun:
+    if getattr(args, "warm_from", None) == "p2p":
+        # p2p warm-start: pull live weights + architecture doc from a
+        # peer shard server (elasticity handover path). Loud on any
+        # failure — a silent cold-init fallback would bring the replica
+        # up serving DIFFERENT weights than the fleet believes it has.
+        if not args.warm_addr:
+            print("error: --warm-from p2p needs --warm-addr",
+                  file=sys.stderr)
+            return 1
+        from edl_tpu.elasticity import weightpush
+        from edl_tpu.models import llama
+
+        t0 = time.perf_counter()
+        try:
+            params, cfg_doc, _step = weightpush.fetch_params(args.warm_addr)
+        except (ConnectionError, OSError, ValueError) as e:
+            print(f"p2p warm-start from {args.warm_addr} failed: {e}",
+                  file=sys.stderr)
+            return 1
+        if cfg_doc is None:
+            print("p2p warm-start: peer served no __config__ doc",
+                  file=sys.stderr)
+            return 1
+        cfg = llama.LlamaConfig.from_meta(cfg_doc)
+        print(f"# replica {args.replica_id} warm from {args.warm_addr} "
+              f"({time.perf_counter() - t0:.3f}s)", file=sys.stderr)
+    elif args.dryrun:
         import jax
 
         from edl_tpu.models import llama
@@ -1423,6 +1449,114 @@ def _run_fleet_replica(args) -> int:
           f"gen={args.generation}", file=sys.stderr)
     stop_evt.wait()
     srv.stop()
+    return 0
+
+
+def run_elasticity(args) -> int:
+    """Policy rehearsal for the train⇄serve elasticity plane: a
+    scripted diurnal load curve driven through the REAL
+    ChipLeaseBroker + ElasticityController + shared ScaleGate, with a
+    fake clock and fake side ports — no devices, no subprocesses, so
+    an operator can see exactly when and why chips would move before
+    pointing the controller at a live fleet. One tick per simulated
+    hour. ``scripts/exp_elasticity.py`` is the live-fleet analog."""
+    from edl_tpu.elasticity.broker import ChipLeaseBroker
+    from edl_tpu.elasticity.controller import (
+        ElasticityController,
+        ServePort,
+        TrainPort,
+    )
+
+    if args.train_chips + args.replicas * args.chips_per_replica > args.chips:
+        print(
+            f"error: bootstrap wants "
+            f"{args.train_chips + args.replicas * args.chips_per_replica} "
+            f"chips, pool holds {args.chips}",
+            file=sys.stderr,
+        )
+        return 1
+
+    clock = {"t": 0.0}
+    state = {"train_chips": args.train_chips, "replicas": args.replicas,
+             "offered": 0.0}
+
+    def offered_load(hour: int) -> float:
+        # the diurnal curve: quiet nights, a hard day plateau, shoulders
+        h = hour % 24
+        if 10 <= h <= 17:
+            return 6.0
+        if h in (8, 9, 18, 19):
+            return 2.0
+        return 0.25
+
+    broker = ChipLeaseBroker(args.chips, clock=lambda: clock["t"])
+    train = TrainPort(
+        chips=lambda: state["train_chips"],
+        apply_chips=lambda n: state.update(train_chips=n),
+        min_chips=args.chips_per_replica,
+    )
+
+    def _add_replica() -> float:
+        state["replicas"] += 1
+        return 0.0
+
+    def _remove_replica() -> None:
+        state["replicas"] -= 1
+
+    serve = ServePort(
+        replicas=lambda: state["replicas"],
+        load=lambda: state["offered"] / max(state["replicas"], 1),
+        slo_breached=lambda: False,
+        add_replica=_add_replica,
+        remove_replica=_remove_replica,
+        min_replicas=1,
+    )
+    ctl = ElasticityController(
+        broker, train, serve,
+        chips_per_replica=args.chips_per_replica,
+        cooldown_s=args.cooldown_s,
+        clock=lambda: clock["t"],
+    )
+    ctl.bootstrap()
+
+    rows = []
+    for hour in range(args.hours):
+        clock["t"] = hour * 3600.0
+        state["offered"] = offered_load(hour)
+        action = ctl.tick()
+        if not broker.check_conservation():
+            print(f"LEASE CONSERVATION VIOLATED at hour {hour}",
+                  file=sys.stderr)
+            return 1
+        rows.append({
+            "hour": hour,
+            "offered": state["offered"],
+            "action": action,
+            "train_chips": state["train_chips"],
+            "replicas": state["replicas"],
+            "free": broker.free_chips,
+            "epoch": broker.epoch,
+        })
+
+    if args.json:
+        print(json.dumps({
+            "rows": rows,
+            "handovers": [h.__dict__ for h in ctl.ledger],
+            "epoch": broker.epoch,
+            "conserved": broker.check_conservation(),
+        }, sort_keys=True))
+        return 0
+    print(f"{'hour':>4} {'offered':>7} {'action':<9} {'train':>5} "
+          f"{'replicas':>8} {'free':>4} {'epoch':>5}")
+    for r in rows:
+        if r["action"] is None and r["hour"] % 6:
+            continue  # quiet hours: print a sample, not 48 idle rows
+        print(f"{r['hour']:>4} {r['offered']:>7.2f} "
+              f"{r['action'] or '-':<9} {r['train_chips']:>5} "
+              f"{r['replicas']:>8} {r['free']:>4} {r['epoch']:>5}")
+    print(f"# {len(ctl.ledger)} handovers over {args.hours}h; "
+          f"final epoch {broker.epoch}; conservation "
+          f"{'OK' if broker.check_conservation() else 'VIOLATED'}")
     return 0
 
 
@@ -2410,7 +2544,48 @@ def build_parser() -> argparse.ArgumentParser:
     fl.add_argument("--port", type=int, default=0, help=argparse.SUPPRESS)
     fl.add_argument("--generation", type=int, default=0,
                     help=argparse.SUPPRESS)
+    # p2p warm-start (set on the spawn path by ReplicaSpec when the
+    # elasticity plane pushes weights instead of cold-loading)
+    fl.add_argument("--warm-from", choices=("p2p",), default=None,
+                    help=argparse.SUPPRESS)
+    fl.add_argument("--warm-addr", default=None, help=argparse.SUPPRESS)
     fl.set_defaults(fn=run_fleet)
+
+    el = sub.add_parser(
+        "elasticity",
+        help="train<->serve chip elasticity rehearsal: drive a "
+        "scripted diurnal load curve through the real lease broker "
+        "+ handover controller (fake clock, fake sides — pure "
+        "policy, no devices) and print the handover ledger",
+    )
+    el.add_argument(
+        "--chips", type=int, default=8,
+        help="total chip inventory in the broker pool",
+    )
+    el.add_argument(
+        "--train-chips", type=int, default=6,
+        help="chips the trainer holds at bootstrap",
+    )
+    el.add_argument(
+        "--replicas", type=int, default=1,
+        help="serving replicas at bootstrap",
+    )
+    el.add_argument(
+        "--chips-per-replica", type=int, default=2,
+        help="chips one serving replica occupies",
+    )
+    el.add_argument(
+        "--hours", type=int, default=48,
+        help="simulated hours to run (one controller tick per hour)",
+    )
+    el.add_argument(
+        "--cooldown-s", type=float, default=0.0,
+        help="handover cooldown through the shared ScaleGate "
+        "(simulated seconds; 1 tick = 3600)",
+    )
+    el.add_argument("--json", action="store_true",
+                    help="machine-readable ledger")
+    el.set_defaults(fn=run_elasticity)
 
     return p
 

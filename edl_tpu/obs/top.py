@@ -219,6 +219,17 @@ def summarize(fams: _Fams) -> List[str]:
             f"cpu={_total(fams, 'edl_fleet_cpu_util_pct'):.1f}% "
             f"jobs={_total(fams, 'edl_fleet_jobs', state='submitted'):.0f}"
         )
+    # chip-lease strip (elasticity broker gauges): who holds the
+    # inventory right now, and how busy the handover plane has been
+    if "edl_lease_chips_free" in fams:
+        lines.append(
+            f"LEASES   train={_total(fams, 'edl_lease_chips', side='train'):.0f} "
+            f"serve={_total(fams, 'edl_lease_chips', side='serve'):.0f} "
+            f"free={_total(fams, 'edl_lease_chips_free'):.0f} "
+            f"recalling={_total(fams, 'edl_leases', state='RECALLING'):.0f} "
+            f"epoch={_total(fams, 'edl_lease_epoch'):.0f} "
+            f"handovers={_total(fams, 'edl_lease_handovers_total'):.0f}"
+        )
 
     if not lines:
         lines.append("(no edl series observed yet)")

@@ -314,6 +314,18 @@ class ElasticTrainer:
         if n_workers != self.n_workers:
             self._scale_target = n_workers
 
+    def apply_chip_grant(self, total_chips: int) -> int:
+        """Consume a chip-lease budget from the elasticity broker
+        (edl_tpu/elasticity): retarget to as many whole workers as
+        ``total_chips`` covers, floored at one worker — the trainer's
+        end of the shared broker-grant interface. Returns the worker
+        count requested."""
+        if total_chips < 0:
+            raise ValueError(f"total_chips must be >= 0, got {total_chips}")
+        n_workers = max(1, total_chips // self.chips_per_worker)
+        self.request_rescale(n_workers)
+        return n_workers
+
     def _feasible(self, n_workers: int) -> bool:
         n_dev = n_workers * self.chips_per_worker
         if n_workers < 1 or n_dev > len(self.pool):

@@ -67,6 +67,52 @@ class HysteresisGate:
         self._last[key] = self.clock()
 
 
+class ScaleGate:
+    """The one damped scale-decision pipeline every elastic consumer
+    shares: ``decide → (cooldown or bypass) → act → record``.
+
+    Before this class the pattern lived copy-pasted in the serving
+    fleet's :class:`~edl_tpu.serving.fleet.FleetScaler` and inline in
+    the elasticity controller's handover loop — each re-implementing
+    the same four lines around a :class:`HysteresisGate` and each free
+    to drift (forget the record, invert the bypass). ``apply`` owns the
+    sequencing; callers supply only the pure ``decide`` (returns an
+    action label or None) and the side-effecting ``act``.
+
+    ``bypass`` is the urgency escape hatch: when it returns True the
+    cooldown is ignored (pending pods for training, an SLO breach for
+    serving — churn is the lesser evil once users are hurting)."""
+
+    def __init__(
+        self,
+        key: str,
+        cooldown_s: float,
+        clock=time.monotonic,
+        bypass: Optional[Callable[[], bool]] = None,
+    ):
+        self.key = key
+        self.gate = HysteresisGate(cooldown_s, clock=clock)
+        self.bypass = bypass
+
+    def apply(
+        self,
+        decide: Callable[[], Optional[str]],
+        act: Callable[[str], None],
+    ) -> Optional[str]:
+        """One damped decision. Returns the action applied, or None
+        (nothing to do, or held by the cooldown)."""
+        action = decide()
+        if action is None:
+            return None
+        if not self.gate.ready(self.key) and not (
+            self.bypass is not None and self.bypass()
+        ):
+            return None
+        act(action)
+        self.gate.record(self.key)
+        return action
+
+
 @dataclass
 class JobState:
     """Autoscaler view of one job (reference: `job`, pkg/autoscaler.go:34-37)."""

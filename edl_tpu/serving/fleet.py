@@ -50,7 +50,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
 from edl_tpu.obs import events as flight
-from edl_tpu.scheduler.autoscaler import HysteresisGate
+from edl_tpu.scheduler.autoscaler import ScaleGate
 from edl_tpu.serving.router import (
     DEAD,
     DRAINING,
@@ -89,6 +89,11 @@ class ReplicaSpec:
     block_size: int = 0
     seed: int = 1
     export_dir: Optional[str] = None
+    # p2p warm-start (edl_tpu/elasticity/weightpush.py): replicas pull
+    # live weights from a shard server at ``warm_addr`` instead of
+    # cold-loading the export / seed-initializing
+    warm_from: Optional[str] = None
+    warm_addr: Optional[str] = None
     extra: List[str] = field(default_factory=list)
 
     def command(
@@ -112,6 +117,10 @@ class ReplicaSpec:
             cmd += ["--export-dir", self.export_dir]
         else:
             cmd += ["--dryrun", "--vocab", str(self.vocab)]
+        if self.warm_from:
+            cmd += ["--warm-from", self.warm_from]
+            if self.warm_addr:
+                cmd += ["--warm-addr", self.warm_addr]
         return cmd + list(self.extra)
 
 
@@ -579,8 +588,8 @@ def _scrape_text(url: str, path: str) -> str:
 
 class FleetScaler:
     """Replica-count controller: queue depth per READY replica and the
-    TTFT SLO drive scale up/down, damped by the autoscaler's
-    :class:`HysteresisGate` so a marginal load signal can't thrash
+    TTFT SLO drive scale up/down, damped through the autoscaler's
+    shared :class:`ScaleGate` so a marginal load signal can't thrash
     drain/spawn cycles. An SLO breach bypasses the cooldown — churn is
     the lesser evil once users are missing deadlines (the serving
     analog of the training loop's pending-pods bypass)."""
@@ -613,7 +622,12 @@ class FleetScaler:
         self.depth_low = depth_low
         self.ttft_slo_s = ttft_slo_s
         self.ttft_p95_s = ttft_p95_s
-        self.gate = HysteresisGate(cooldown_s, clock=clock)
+        self._scale_gate = ScaleGate(
+            "fleet", cooldown_s, clock=clock, bypass=self._slo_breached
+        )
+        # the underlying HysteresisGate, kept addressable so tests and
+        # the CLI can poke cooldown state directly
+        self.gate = self._scale_gate.gate
 
     def _slo_breached(self) -> bool:
         if self.ttft_slo_s is None or self.ttft_p95_s is None:
@@ -637,18 +651,15 @@ class FleetScaler:
 
     def tick(self, fleet: "ServingFleet") -> Optional[str]:
         """One damped decision, applied through the fleet. Returns the
-        action taken (None = held)."""
-        action = self.decide()
-        if action is None:
-            return None
-        if not self.gate.ready("fleet") and not self._slo_breached():
-            return None
-        if action == "up":
-            fleet.scale_up()
-        else:
-            fleet.scale_down()
-        self.gate.record("fleet")
-        return action
+        action taken (None = held). The decide→gate→act→record
+        sequencing lives in the shared :class:`ScaleGate` — the same
+        pipeline the elasticity controller's handover loop runs."""
+        return self._scale_gate.apply(
+            self.decide,
+            lambda action: (
+                fleet.scale_up() if action == "up" else fleet.scale_down()
+            ),
+        )
 
 
 # ---------------------------------------------------------------------------

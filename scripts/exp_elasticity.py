@@ -1,0 +1,476 @@
+"""Elasticity chaos demo: train⇄serve chip handovers over a diurnal
+load curve, with hard zero-lost/zero-divergence invariants.
+
+One chip pool (8 virtual CPU devices) is split between a live
+``ElasticTrainer`` (linreg, in-place reshard) and a REAL subprocess
+serving fleet (``edl fleet --replica`` processes warm-started over the
+p2p weight push). The ``ChipLeaseBroker`` owns the inventory as
+leases; the ``ElasticityController`` watches a scripted day/night load
+curve and moves chips through GRANTED→RECALLING→FREED handovers:
+
+* **day** — serving load crosses ``load_high``: the train lease is
+  recalled, the trainer shrink-reshards in place, the freed chips are
+  granted to serving, and a new replica spawns WARM — it pulls the
+  seed-7 params from the harness's shard server
+  (``elasticity/weightpush.py``), never touching disk. Replica seed is
+  1, so token identity against the seed-7 reference PROVES the weights
+  actually travelled the wire.
+* **night** — load falls under ``load_low``: drain-before-evict one
+  replica (in-flight streams finish, residuals requeue), free its
+  lease, recall+regrow the train lease, grow-reshard the trainer.
+
+An armed ``lease.recall:raise@n=1`` breaks the first recall RPC; the
+controller's retry recovers it and emits the ``lease.recover`` that
+``edl postmortem --assert-recovered --sites lease.`` verifies — both
+in-process here and over the dump in run_tests.sh phase 13.
+
+Invariants, all hard-asserted:
+
+* ≥ 2 full handover cycles (≥ 2 to_serve and ≥ 2 to_train);
+* lease conservation (leased + free == pool) after every control tick;
+* every serving request finishes done/eos exactly once, tokens
+  IDENTICAL to the fault-free seed-7 reference — across spawns,
+  drains, and evictions;
+* training is loss- and param-IDENTICAL to a fault-free replay that
+  applies the same rescale schedule without broker or faults — the
+  handover machinery perturbs nothing numerically;
+* the armed recall fault FIRED and its recovery chain closed.
+
+Prints a ``ELASTICITY_MEASURE`` line (handover stall, grant→READY
+ramp, p2p fetch vs cold export+load seconds) that scripts/bench.py's
+elasticity rung and scripts/perf_gate.py consume.
+
+    python scripts/exp_elasticity.py --dryrun [--seed 0] [--events-dir D]
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from edl_tpu.utils.platform import force_virtual_cpu  # noqa: E402
+
+force_virtual_cpu(8)
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+from edl_tpu.elasticity import weightpush  # noqa: E402
+from edl_tpu.elasticity.broker import ChipLeaseBroker  # noqa: E402
+from edl_tpu.elasticity.controller import (  # noqa: E402
+    ElasticityController,
+    ServePort,
+    TrainPort,
+)
+from edl_tpu.models import linreg, llama  # noqa: E402
+from edl_tpu.obs import events as flight  # noqa: E402
+from edl_tpu.obs import postmortem as pm  # noqa: E402
+from edl_tpu.runtime import export as export_mod  # noqa: E402
+from edl_tpu.runtime.elastic import ElasticTrainer  # noqa: E402
+from edl_tpu.serving.engine import ContinuousBatchingEngine  # noqa: E402
+from edl_tpu.serving.fleet import (  # noqa: E402
+    ReplicaSpec,
+    ReplicaSupervisor,
+    ServingFleet,
+)
+from edl_tpu.serving.router import (  # noqa: E402
+    HttpTransport,
+    ReplicaTable,
+    Router,
+)
+from edl_tpu.serving.scheduler import Request  # noqa: E402
+from edl_tpu.utils import faults  # noqa: E402
+
+VOCAB = 96
+PUSH_SEED = 7  # the pushed weights; ReplicaSpec.seed stays 1 (cold
+#               init would serve seed-1 → token check catches it)
+TOTAL_CHIPS = 8
+TRAIN_CHIPS0 = 6
+CHIPS_PER_REPLICA = 2
+STEPS_PER_HOUR = 2
+
+
+def offered_load(hour):
+    """Scripted diurnal queue-depth-per-replica signal (same curve the
+    jax-free `edl elasticity` rehearsal runs)."""
+    h = hour % 24
+    if 10 <= h <= 17:
+        return 6.0
+    if h in (8, 9, 18, 19):
+        return 2.0
+    return 0.25
+
+
+def build_workload(tag, n, seed):
+    import random
+
+    rng = random.Random(f"{seed}/{tag}")
+    reqs = []
+    for i in range(n):
+        prompt = [rng.randrange(2, VOCAB) for _ in range(3 + i % 5)]
+        reqs.append({
+            "rid": f"{tag}-{i}", "prompt": prompt, "max_new": 5 + i % 4,
+        })
+    return reqs
+
+
+def reference_tokens(params, cfg, all_reqs):
+    """Fault-free ground truth from the PUSHED (seed-7) weights served
+    in-process — the oracle every warm replica must match exactly."""
+    eng = ContinuousBatchingEngine(
+        params, cfg, max_slots=4, max_len=96, horizon=4
+    )
+    ref, pend = {}, []
+    for r in all_reqs:
+        key = (tuple(r["prompt"]), r["max_new"])
+        if key in ref or key in [k for k, _ in pend]:
+            continue
+        rid = f"ref{len(pend)}"
+        eng.submit(rid, r["prompt"], r["max_new"])
+        pend.append((key, rid))
+    res = eng.run()
+    for key, rid in pend:
+        assert res[rid].outcome in ("done", "eos"), (rid, res[rid].outcome)
+        ref[key] = res[rid].tokens
+    return ref
+
+
+def drive(fleet, reqs, results, stagger_s=0.05):
+    lock = threading.Lock()
+
+    def one(r):
+        res = fleet.generate(
+            Request(rid=r["rid"], prompt=r["prompt"], max_new=r["max_new"])
+        )
+        with lock:
+            assert r["rid"] not in results, f"DUPLICATE result {r['rid']}"
+            results[r["rid"]] = res
+
+    threads = []
+    for r in reqs:
+        t = threading.Thread(target=one, args=(r,))
+        t.start()
+        threads.append(t)
+        time.sleep(stagger_s)
+    return threads
+
+
+def check_serving(all_reqs, results, ref):
+    assert set(results) == {r["rid"] for r in all_reqs}, (
+        "lost requests: "
+        f"{sorted({r['rid'] for r in all_reqs} - set(results))}"
+    )
+    for r in all_reqs:
+        res = results[r["rid"]]
+        assert res.outcome in ("done", "eos"), (
+            f"{r['rid']} finished {res.outcome!r}"
+        )
+        want = ref[(tuple(r["prompt"]), r["max_new"])]
+        assert res.tokens == want, (
+            f"{r['rid']} tokens diverged from the seed-{PUSH_SEED} "
+            f"reference after {res.failovers} failover(s): "
+            f"{res.tokens} != {want} — did the p2p warm push actually "
+            "carry the weights?"
+        )
+
+
+def make_data(seed):
+    x, y = linreg.synthetic_dataset(4096, seed=seed)
+    cursor = {"i": 0}
+
+    def data_fn(bs):
+        lo = (cursor["i"] * 97) % (len(x) - bs)
+        cursor["i"] += 1
+        return {"x": x[lo:lo + bs], "y": y[lo:lo + bs]}
+
+    return data_fn
+
+
+def make_trainer(seed):
+    tr = ElasticTrainer(
+        linreg.loss_fn, optax.sgd(0.05), chips_per_worker=1,
+        per_chip_batch=8,
+    )
+    tr.start(linreg.init_params(jax.random.PRNGKey(seed)),
+             n_workers=TRAIN_CHIPS0)
+    return tr
+
+
+def replay_training(seed, hours, schedule):
+    """The fault-free twin: same data stream, same rescale schedule at
+    the same hour boundaries — but no broker, no controller, no armed
+    faults. Its losses/params are the identity oracle."""
+    tr = make_trainer(seed)
+    data_fn = make_data(seed)
+    sched = dict(schedule)
+    for h in range(hours):
+        if h in sched:
+            tr.apply_chip_grant(sched[h])
+        tr.train_steps(data_fn, STEPS_PER_HOUR)
+    return tr
+
+
+def dump_merged(path, sup, table, evicted_events):
+    """One timeline: this process (broker + controller + trainer) plus
+    every replica's /events scrape plus the pre-evict scrapes."""
+    recs = list(flight.default_recorder().records())
+    for records in evicted_events:
+        recs.extend(records)
+    for rid in table.ids():
+        h = sup.handle(rid)
+        if h is None or not h.url:
+            continue
+        try:
+            recs.extend(pm.load_events(h.url))
+        except ValueError:
+            pass  # fresh replica, empty recorder
+        except (ConnectionError, OSError) as e:
+            print(f"  WARN: /events scrape of {rid} failed: {e}",
+                  file=sys.stderr)
+    recs.sort(key=lambda e: (e.get("t_wall", 0.0), e.get("seq", 0)))
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    return recs
+
+
+def measure_cold_vs_p2p(params, cfg, addr, workdir):
+    """The satellite comparison: p2p fetch from live RAM vs the cold
+    disk round trip (export publish + export load) for the SAME tree."""
+    t0 = time.perf_counter()
+    fetched, cfg_doc, _step = weightpush.fetch_params(addr)
+    warm_s = time.perf_counter() - t0
+    assert cfg_doc is not None and cfg_doc.get("family") == "llama"
+    want = {k: np.asarray(v) for k, v in export_mod._leaf_keys(params)}
+    got = dict(export_mod._leaf_keys(fetched))
+    assert set(got) == set(want), "p2p fetch dropped leaves"
+    for k in want:
+        np.testing.assert_array_equal(np.asarray(got[k]), want[k])
+
+    exp_dir = os.path.join(workdir, "export")
+    t0 = time.perf_counter()
+    export_mod.export_params(
+        exp_dir, params, step=0, dtype="float32",
+        model_meta=cfg.to_meta(),
+    )
+    _loaded, _doc = export_mod.load_export(exp_dir)
+    cold_s = time.perf_counter() - t0
+    return warm_s, cold_s
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--dryrun", action="store_true",
+                    help="CI lane (fixed small curve; the only mode)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--hours", type=int, default=48,
+                    help="simulated hours (48 = two diurnal cycles)")
+    ap.add_argument("--events-dir", default=None,
+                    help="dump the merged timeline here "
+                    "(chaos-elasticity.jsonl) for `edl postmortem "
+                    "--assert-recovered --sites lease.`")
+    args = ap.parse_args()
+    if args.events_dir:
+        os.makedirs(args.events_dir, exist_ok=True)
+    assert not faults.armed(), (
+        "refusing to run with a pre-armed EDL_FAULTS plan: the harness "
+        "owns the fault schedule"
+    )
+
+    cfg = llama.LlamaConfig.tiny(vocab=VOCAB)
+    push_params = jax.jit(
+        lambda: llama.init_params(jax.random.PRNGKey(PUSH_SEED), cfg)
+    )()
+    bursts = {
+        f"b{i}": build_workload(f"b{i}", 3, args.seed) for i in range(12)
+    }
+    smoke = build_workload("smoke", 2, args.seed)
+    all_reqs = smoke + [r for b in bursts.values() for r in b]
+    driven = list(smoke)  # grows as handover bursts actually launch
+    print("== reference: fault-free in-process run (pushed weights) ==")
+    ref = reference_tokens(push_params, cfg, all_reqs)
+
+    print("== weight push: shard server over live seed-7 params ==")
+    push_srv = weightpush.serve_params(push_params, cfg.to_meta())
+    push_addr = f"127.0.0.1:{push_srv.port}"
+
+    workdir = tempfile.mkdtemp(prefix="edl-elasticity-")
+    spec = ReplicaSpec(
+        workdir=workdir, vocab=VOCAB, slots=4, max_len=96, horizon=4,
+        seed=1, warm_from="p2p", warm_addr=push_addr,
+    )
+    table = ReplicaTable()
+    evicted_events = []
+    sup = ReplicaSupervisor(
+        table, spec,
+        events_sink=lambda rid, recs: evicted_events.append(recs),
+    )
+    router = Router(table, transport=HttpTransport(), seed=args.seed,
+                    pick_wait_s=30.0)
+    fleet = ServingFleet(sup, router)
+
+    trainer = make_trainer(args.seed)
+    data_fn = make_data(args.seed)
+    state = {"train_chips": TRAIN_CHIPS0, "load": 0.25}
+    schedule = {}  # hour -> chip total applied (the replay oracle)
+    hour_box = {"h": 0}
+
+    def apply_chips(chips):
+        state["train_chips"] = chips
+        schedule[hour_box["h"]] = chips
+        trainer.apply_chip_grant(chips)
+
+    def add_replica():
+        t0 = time.perf_counter()
+        fleet.scale_up()
+        return time.perf_counter() - t0
+
+    broker = ChipLeaseBroker(TOTAL_CHIPS)
+    controller = ElasticityController(
+        broker,
+        TrainPort(chips=lambda: state["train_chips"],
+                  apply_chips=apply_chips,
+                  min_chips=TRAIN_CHIPS0 - CHIPS_PER_REPLICA),
+        ServePort(replicas=lambda: len(table.ids()),
+                  load=lambda: state["load"],
+                  slo_breached=lambda: False,
+                  add_replica=add_replica,
+                  remove_replica=lambda: fleet.scale_down(),
+                  min_replicas=1),
+        chips_per_replica=CHIPS_PER_REPLICA,
+        load_high=4.0, load_low=0.5, cooldown_s=0.0,
+    )
+
+    results = {}
+    ok = False
+    try:
+        print("== boot: 1 warm replica + 6-worker trainer ==")
+        fleet.start(1)
+        controller.bootstrap()
+        assert broker.check_conservation()
+        threads = drive(fleet, smoke, results)
+        for t in threads:
+            t.join(timeout=120)
+            assert not t.is_alive(), "smoke request wedged"
+
+        warm_s, cold_s = measure_cold_vs_p2p(
+            push_params, cfg, push_addr, workdir
+        )
+        print(f"  warm p2p fetch {warm_s:.3f}s vs cold export+load "
+              f"{cold_s:.3f}s")
+
+        # the first recall RPC of the run fails once; the controller's
+        # retry must recover it and close the lease.* postmortem chain
+        faults.arm("lease.recall:raise@n=1", seed=args.seed)
+
+        burst_i = 0
+        print(f"== diurnal loop: {args.hours} simulated hours ==")
+        for h in range(args.hours):
+            hour_box["h"] = h
+            state["load"] = offered_load(h)
+            pending = controller.decide()
+            threads = []
+            if pending and burst_i < len(bursts):
+                # put real streams in flight across the handover so
+                # drain-before-evict / warm spawn run under traffic
+                burst = bursts[f"b{burst_i}"]
+                threads = drive(fleet, burst, results)
+                driven.extend(burst)
+                burst_i += 1
+                time.sleep(0.2)
+            action = controller.tick()
+            if action:
+                hd = controller.ledger[-1]
+                print(f"  [h{h:02d}] load={state['load']:.2f} "
+                      f"handover {hd.n}: {hd.direction} "
+                      f"wall={hd.wall_s:.2f}s "
+                      f"ramp={hd.ramp_s if hd.ramp_s is None else round(hd.ramp_s, 2)} "
+                      f"retries={hd.recall_retries} "
+                      f"train_chips={state['train_chips']} "
+                      f"replicas={len(table.ids())}")
+            for t in threads:
+                t.join(timeout=120)
+                assert not t.is_alive(), f"request wedged at hour {h}"
+            assert broker.check_conservation(), f"conservation at h{h}"
+            trainer.train_steps(data_fn, STEPS_PER_HOUR)
+        fired = faults.counts()
+        faults.disarm()
+
+        # -- invariants ------------------------------------------------------
+        to_serve = [x for x in controller.ledger if x.direction == "to_serve"]
+        to_train = [x for x in controller.ledger if x.direction == "to_train"]
+        assert len(to_serve) >= 2 and len(to_train) >= 2, (
+            f"need >=2 full cycles, got {len(to_serve)} to_serve / "
+            f"{len(to_train)} to_train"
+        )
+        assert fired.get("lease.recall", 0) >= 1, (
+            "armed lease.recall fault never fired"
+        )
+        assert any(x.recall_retries for x in controller.ledger), (
+            "no handover recorded the recall retry"
+        )
+        assert len(driven) >= len(smoke) + 3 * len(controller.ledger), (
+            "handover bursts were not driven across every handover"
+        )
+        check_serving(driven, results, ref)
+        print(f"  serving: {len(results)} requests done/eos, "
+              f"token-identical to the pushed weights")
+
+        reshards = trainer.report.reshards
+        assert len(reshards) == len(controller.ledger), (
+            f"{len(controller.ledger)} handovers but {len(reshards)} "
+            "trainer reshards"
+        )
+        print("== replay: fault-free twin with the same schedule ==")
+        twin = replay_training(args.seed, args.hours, schedule)
+        assert trainer.report.losses == twin.report.losses, (
+            "training losses diverged from the fault-free replay"
+        )
+        from edl_tpu.parallel import sharding as shd
+
+        live_p = shd.to_host(trainer.state.params)
+        twin_p = shd.to_host(twin.state.params)
+        for k in live_p:
+            np.testing.assert_array_equal(
+                np.asarray(live_p[k]), np.asarray(twin_p[k])
+            )
+        print(f"  training: {trainer.report.steps} steps, "
+              f"{len(reshards)} reshards, loss/params identical to the "
+              "fault-free replay")
+
+        # -- postmortem + dump ----------------------------------------------
+        path = (os.path.join(args.events_dir, "chaos-elasticity.jsonl")
+                if args.events_dir else os.devnull)
+        evs = dump_merged(path, sup, table, evicted_events)
+        if args.events_dir:
+            print(f"  merged timeline -> {path} ({len(evs)} events)")
+        probs = pm.verify_recovered(evs, site_prefix="lease.")
+        assert not probs, f"lease postmortem: {probs}"
+
+        stall = max(ev.stall_s for ev in reshards)
+        ramp = max(x.ramp_s for x in controller.ledger
+                   if x.ramp_s is not None)
+        print(f"ELASTICITY_MEASURE handover_stall_s={stall:.4f} "
+              f"grant_ready_s={ramp:.4f} warm_fetch_s={warm_s:.4f} "
+              f"cold_load_s={cold_s:.4f} handovers={len(controller.ledger)}")
+        print("EXP ELASTICITY OK")
+        ok = True
+        return 0
+    finally:
+        faults.disarm()
+        fleet.stop()
+        push_srv.close()
+        if ok:  # keep replica logs around when a run failed
+            import shutil
+
+            shutil.rmtree(workdir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -324,6 +324,35 @@ PY
 rm -rf "$SPDIR"
 t12=$(date +%s)
 echo "== phase 12 done in $((t12 - t11))s (rc=$rc12) =="
-echo "== total $((t12 - t0))s =="
 
-[ "$rc0" -eq 0 ] && [ "$rc1" -eq 0 ] && [ "$rc2" -eq 0 ] && [ "$rc3" -eq 0 ] && [ "$rc4" -eq 0 ] && [ "$rc5" -eq 0 ] && [ "$rc6" -eq 0 ] && [ "$rc7" -eq 0 ] && [ "$rc8" -eq 0 ] && [ "$rc9" -eq 0 ] && [ "$rc10" -eq 0 ] && [ "$rc11" -eq 0 ] && [ "$rc12" -eq 0 ]
+echo "== phase 13: train<->serve elasticity lane (exp_elasticity --dryrun + postmortem gate) =="
+# one chip pool split between a live ElasticTrainer and a real
+# subprocess fleet, driven over a scripted 48h day/night curve by the
+# ChipLeaseBroker + ElasticityController: >=2 full to_serve/to_train
+# handover cycles, replicas warm-started over the p2p weight push
+# (token identity vs the PUSHED seed-7 weights proves the transfer —
+# a silent cold init would serve seed-1), zero lost/duplicated serving
+# requests across every drain/spawn, training loss- and param-
+# identical to a fault-free replay of the same rescale schedule, lease
+# conservation after every tick, and an armed lease.recall fault whose
+# retry recovery the merged dump must prove — re-verified from OUTSIDE
+# by `edl postmortem --assert-recovered --sites lease.`.
+ELDIR="${TMPDIR:-/tmp}/edl-elasticity-events.$$"
+rm -rf "$ELDIR"
+rc13=0
+JAX_PLATFORMS=cpu python scripts/exp_elasticity.py --dryrun --seed 0 \
+    --events-dir "$ELDIR" || rc13=1
+f="$ELDIR/chaos-elasticity.jsonl"
+if [ -e "$f" ]; then
+  python -m edl_tpu.cli postmortem "$f" --assert-recovered \
+      --sites lease. > /dev/null \
+    || { echo "postmortem FAILED for $f (lease.*)"; rc13=1; }
+else
+  echo "missing elasticity dump $f"; rc13=1
+fi
+rm -rf "$ELDIR"
+t13=$(date +%s)
+echo "== phase 13 done in $((t13 - t12))s (rc=$rc13) =="
+echo "== total $((t13 - t0))s =="
+
+[ "$rc0" -eq 0 ] && [ "$rc1" -eq 0 ] && [ "$rc2" -eq 0 ] && [ "$rc3" -eq 0 ] && [ "$rc4" -eq 0 ] && [ "$rc5" -eq 0 ] && [ "$rc6" -eq 0 ] && [ "$rc7" -eq 0 ] && [ "$rc8" -eq 0 ] && [ "$rc9" -eq 0 ] && [ "$rc10" -eq 0 ] && [ "$rc11" -eq 0 ] && [ "$rc12" -eq 0 ] && [ "$rc13" -eq 0 ]

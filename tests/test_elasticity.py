@@ -1,0 +1,407 @@
+"""Chip-lease broker + elasticity controller state machine.
+
+The broker tests pin the lease lifecycle jax-free (this module imports
+no jax at top level — the policy plane must stay importable on a
+device-free control node); the weightpush roundtrip imports jax inside
+the test. The ``lease.recall`` chaos site is armed here to prove the
+controller's recall retry closes the postmortem fault chain.
+"""
+
+import threading
+
+import pytest
+
+from edl_tpu.elasticity.broker import (
+    FREED,
+    GRANTED,
+    RECALLING,
+    ChipLeaseBroker,
+    LeaseError,
+)
+from edl_tpu.elasticity.controller import (
+    ElasticityController,
+    ServePort,
+    TrainPort,
+)
+from edl_tpu.obs import events as flight
+from edl_tpu.obs.metrics import MetricsRegistry
+from edl_tpu.scheduler.autoscaler import ScaleGate
+from edl_tpu.utils import faults
+
+
+class Clock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def _broker(chips: int = 8, clock=None) -> ChipLeaseBroker:
+    return ChipLeaseBroker(
+        chips, registry=MetricsRegistry(), clock=clock or Clock()
+    )
+
+
+# ---------------------------------------------------------------------------
+# broker state machine
+
+
+def test_lease_lifecycle_and_conservation():
+    b = _broker(8)
+    a = b.grant("train:job", 6)
+    assert a.state == GRANTED and a.chips == 6
+    assert b.free_chips == 2
+    assert b.check_conservation()
+
+    r = b.recall(a.lease_id)
+    assert r.state == RECALLING
+    assert b.free_chips == 2  # chips stay with the holder until free
+    assert b.check_conservation()
+
+    assert b.free(a.lease_id) == 6
+    assert b.get(a.lease_id).state == FREED
+    assert b.free_chips == 8
+    assert b.check_conservation()
+
+
+def test_epoch_monotonic_across_grants():
+    b = _broker(8)
+    epochs = []
+    for i in range(3):
+        lease = b.grant(f"serve:r{i}", 2)
+        epochs.append(lease.epoch)
+        b.recall(lease.lease_id)
+        b.free(lease.lease_id)
+    more = b.grant("train:job", 8)
+    epochs.append(more.epoch)
+    assert epochs == sorted(epochs)
+    assert len(set(epochs)) == len(epochs)  # strictly increasing
+    assert b.epoch == epochs[-1]
+
+
+def test_double_grant_rejected():
+    b = _broker(4)
+    b.grant("train:job", 3)
+    with pytest.raises(LeaseError, match="only 1/4"):
+        b.grant("serve:r0", 2)  # pool can't cover it
+    assert b.free_chips == 1
+    assert b.check_conservation()
+
+
+def test_recall_while_recalling_is_idempotent():
+    flight.reset_default_recorder()
+    b = _broker(4)
+    lease = b.grant("train:job", 4)
+    first = b.recall(lease.lease_id)
+    again = b.recall(lease.lease_id)  # retried recall RPC: no-op
+    assert first.state == again.state == RECALLING
+    assert first.recalled_t == again.recalled_t
+    # exactly one lease.recall event despite two calls
+    evs = [e for e in flight.default_recorder().records()
+           if e["kind"] == "lease.recall"
+           and e["attrs"].get("lease") == lease.lease_id]
+    assert len(evs) == 1
+
+
+def test_free_requires_recall_first():
+    b = _broker(4)
+    lease = b.grant("train:job", 2)
+    with pytest.raises(LeaseError, match="not RECALLING"):
+        b.free(lease.lease_id)
+    b.recall(lease.lease_id)
+    assert b.free(lease.lease_id) == 2
+    assert b.free(lease.lease_id) == 0  # idempotent repeat
+    with pytest.raises(LeaseError, match="already FREED"):
+        b.recall(lease.lease_id)
+
+
+def test_unknown_lease_raises():
+    b = _broker(2)
+    with pytest.raises(LeaseError, match="unknown"):
+        b.recall("L9999")
+    with pytest.raises(LeaseError, match="unknown"):
+        b.free("L9999")
+
+
+def test_holder_crash_mid_recalling_returns_chips():
+    b = _broker(8)
+    stuck = b.grant("serve:r0", 2)
+    held = b.grant("serve:r0", 2)
+    other = b.grant("train:job", 4)
+    b.recall(stuck.lease_id)  # recall sent, ack never comes
+    dead = b.holder_crashed("serve:r0")
+    assert sorted(l.lease_id for l in dead) == sorted(
+        [stuck.lease_id, held.lease_id]
+    )
+    assert all(l.state == FREED for l in dead)
+    assert b.free_chips == 4  # both leases back in the pool
+    assert b.check_conservation()
+    assert b.get(other.lease_id).state == GRANTED  # bystander untouched
+    assert b.holder_crashed("serve:r0") == []  # settled: second call no-op
+
+
+def test_broker_thread_safety_smoke():
+    # the deterministic-scheduler proof lives in `edl schedcheck
+    # lease-broker`; this is the plain-threads sanity pass
+    b = _broker(16)
+
+    def churn(side: str) -> None:
+        for i in range(25):
+            try:
+                lease = b.grant(f"{side}:h{i}", 1)
+            except LeaseError:
+                continue
+            b.recall(lease.lease_id)
+            b.free(lease.lease_id)
+
+    ts = [threading.Thread(target=churn, args=(s,))
+          for s in ("train", "serve", "train")]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert b.free_chips == 16
+    assert b.check_conservation()
+
+
+# ---------------------------------------------------------------------------
+# the shared ScaleGate
+
+
+def test_scale_gate_damps_and_records():
+    clk = Clock()
+    gate = ScaleGate("k", 30.0, clock=clk)
+    acts = []
+    out = gate.apply(lambda: "up", acts.append)
+    assert out == "up" and acts == ["up"]
+    # inside the cooldown: held
+    clk.t = 10.0
+    assert gate.apply(lambda: "up", acts.append) is None
+    assert acts == ["up"]
+    # cooldown elapsed
+    clk.t = 31.0
+    assert gate.apply(lambda: "down", acts.append) == "down"
+    assert acts == ["up", "down"]
+
+
+def test_scale_gate_bypass_and_none_decision():
+    clk = Clock()
+    urgent = {"on": False}
+    gate = ScaleGate("k", 60.0, clock=clk, bypass=lambda: urgent["on"])
+    acts = []
+    assert gate.apply(lambda: None, acts.append) is None  # nothing to do
+    assert gate.apply(lambda: "up", acts.append) == "up"
+    assert gate.apply(lambda: "up", acts.append) is None  # cooled down
+    urgent["on"] = True
+    assert gate.apply(lambda: "up", acts.append) == "up"  # bypass wins
+    assert acts == ["up", "up"]
+
+
+# ---------------------------------------------------------------------------
+# controller policy loop (fake ports, fake clock)
+
+
+class FakeSides:
+    """Train + serve stand-ins sharing one mutable state doc."""
+
+    def __init__(self, train_chips: int = 6, replicas: int = 1,
+                 chips_per_replica: int = 2):
+        self.train_chips = train_chips
+        self.replicas = replicas
+        self.offered = 0.0
+        self.breach = False
+        self.ramps = 0
+
+    def train_port(self) -> TrainPort:
+        return TrainPort(
+            chips=lambda: self.train_chips,
+            apply_chips=lambda n: setattr(self, "train_chips", n),
+            min_chips=2,
+        )
+
+    def serve_port(self) -> ServePort:
+        def add() -> float:
+            self.replicas += 1
+            self.ramps += 1
+            return 0.5
+
+        def rm() -> None:
+            self.replicas -= 1
+
+        return ServePort(
+            replicas=lambda: self.replicas,
+            load=lambda: self.offered / max(self.replicas, 1),
+            slo_breached=lambda: self.breach,
+            add_replica=add,
+            remove_replica=rm,
+            min_replicas=1,
+        )
+
+
+def _controller(sides: FakeSides, clk: Clock, broker=None,
+                **kw) -> ElasticityController:
+    broker = broker or _broker(8, clock=clk)
+    kw.setdefault("chips_per_replica", 2)
+    kw.setdefault("cooldown_s", 0.0)
+    ctl = ElasticityController(
+        broker, sides.train_port(), sides.serve_port(),
+        clock=clk, registry=MetricsRegistry(), **kw
+    )
+    ctl.bootstrap()
+    return ctl
+
+
+def test_controller_full_diurnal_cycle():
+    clk = Clock()
+    sides = FakeSides(train_chips=6, replicas=1)
+    ctl = _controller(sides, clk)
+    broker = ctl.broker
+    assert broker.free_chips == 0  # bootstrap leased everything
+
+    sides.offered = 12.0  # day: load per replica = 12 > 4
+    assert ctl.tick() == "to_serve"
+    assert sides.train_chips == 4 and sides.replicas == 2
+    assert broker.check_conservation()
+
+    sides.offered = 0.4  # night: load 0.2 < 0.5
+    assert ctl.tick() == "to_train"
+    assert sides.train_chips == 6 and sides.replicas == 1
+    assert broker.check_conservation()
+    assert [h.direction for h in ctl.ledger] == ["to_serve", "to_train"]
+    assert ctl.ledger[0].ramp_s == 0.5
+    # epochs on the ledger are strictly increasing
+    assert ctl.ledger[0].epoch < ctl.ledger[1].epoch
+
+
+def test_controller_respects_floors():
+    clk = Clock()
+    sides = FakeSides(train_chips=4, replicas=2)
+    ctl = _controller(sides, clk)
+    # train floor: shedding a replica's worth would leave 2 == min_chips,
+    # still allowed; one more would go below — two ticks, second held
+    sides.offered = 100.0
+    assert ctl.tick() == "to_serve"
+    assert sides.train_chips == 2
+    assert ctl.tick() is None  # floor: trainer can't shrink further
+    # serve floor
+    sides.offered = 0.0
+    ctl.tick()
+    ctl.tick()
+    ctl.tick()
+    assert sides.replicas == 1  # min_replicas holds
+    assert ctl.tick() is None
+
+
+def test_controller_cooldown_and_slo_bypass():
+    clk = Clock()
+    sides = FakeSides(train_chips=8, replicas=1)
+    ctl = _controller(sides, clk, broker=_broker(10, clock=clk),
+                      cooldown_s=300.0)
+    sides.offered = 50.0
+    assert ctl.tick() == "to_serve"
+    assert ctl.tick() is None  # cooled down
+    sides.breach = True  # SLO breach bypasses the cooldown
+    assert ctl.tick() == "to_serve"
+    assert sides.replicas == 3
+
+
+def test_recall_retry_emits_lease_recover():
+    flight.reset_default_recorder()
+    clk = Clock()
+    sides = FakeSides(train_chips=6, replicas=1)
+    ctl = _controller(sides, clk)
+    faults.arm("lease.recall:raise@n=1")
+    try:
+        sides.offered = 12.0
+        assert ctl.tick() == "to_serve"  # retry inside the handover
+        assert faults.counts().get("lease.recall") == 1
+    finally:
+        faults.disarm()
+    evs = flight.default_recorder().records()
+    kinds = [e["kind"] for e in evs]
+    assert "fault.injected" in kinds
+    assert "lease.recover" in kinds
+    rec = next(e for e in evs if e["kind"] == "lease.recover")
+    assert rec["corr"]["site"] == "lease.recall"
+    assert rec["attrs"]["rids"] == []
+    assert ctl.ledger[-1].recall_retries == 1
+    # the dump closes the fault chain under the lease. site prefix
+    from edl_tpu.obs import postmortem as pm
+
+    problems = pm.verify_recovered(evs, site_prefix="lease.")
+    assert problems == [], problems
+
+
+def test_recall_retries_exhausted_raises():
+    from edl_tpu.elasticity.controller import LeaseRecallFailed
+
+    clk = Clock()
+    sides = FakeSides(train_chips=6, replicas=1)
+    ctl = _controller(sides, clk, recall_retries=1)
+    faults.arm("lease.recall:raise@every=1")
+    try:
+        sides.offered = 12.0
+        with pytest.raises(LeaseRecallFailed):
+            ctl.tick()
+    finally:
+        faults.disarm()
+    # the failed recall never moved state: the lease is still live and
+    # conservation holds
+    assert ctl.broker.check_conservation()
+    assert sides.train_chips == 6 and sides.replicas == 1
+
+
+# ---------------------------------------------------------------------------
+# p2p weight push (jax inside the test: the wire plane needs arrays)
+
+
+def test_weightpush_roundtrip(cpu_devices):
+    import numpy as np
+
+    from edl_tpu.elasticity import weightpush
+    from edl_tpu.models import llama
+
+    cfg = llama.LlamaConfig.tiny(vocab=64)
+    import jax
+
+    params = llama.init_params(jax.random.PRNGKey(7), cfg)
+    srv = weightpush.serve_params(params, cfg.to_meta(), step=123)
+    try:
+        got, doc, step = weightpush.fetch_params(f"127.0.0.1:{srv.port}")
+    finally:
+        srv.close()
+    assert step == 123
+    assert doc == cfg.to_meta()
+    from edl_tpu.runtime.checkpoint import _leaf_keys
+
+    flat_in = {k: np.asarray(v) for k, v in _leaf_keys(params)}
+    flat_out = {k: np.asarray(v) for k, v in _leaf_keys(got)}
+    assert set(flat_out.keys()) == set(flat_in.keys())
+    for k, v in flat_in.items():
+        np.testing.assert_array_equal(flat_out[k], v)
+
+
+def test_weightpush_dead_peer_raises():
+    from edl_tpu.elasticity import weightpush
+
+    with pytest.raises(ConnectionError):
+        weightpush.fetch_params("127.0.0.1:1", timeout_s=0.2)
+
+
+# ---------------------------------------------------------------------------
+# CLI rehearsal verb
+
+
+def test_cli_elasticity_json(capsys):
+    import json
+
+    from edl_tpu.cli.main import main
+
+    rc = main(["elasticity", "--hours", "48", "--json"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["conserved"] is True
+    dirs = [h["direction"] for h in doc["handovers"]]
+    # two full day/night cycles in 48 scripted hours
+    assert dirs.count("to_serve") >= 2 and dirs.count("to_train") >= 2
