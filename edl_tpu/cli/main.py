@@ -987,6 +987,10 @@ def run_serve(args) -> int:
         print("--prefix-cache/--prefill-chunk require --block-size > 0",
               file=sys.stderr)
         return 1
+    if args.kv_quant != "off" and not args.block_size:
+        print("--kv-quant requires the paged KV cache (--block-size > 0)",
+              file=sys.stderr)
+        return 1
     if args.spec_k < 0:
         print(f"--spec-k must be >= 0, got {args.spec_k}", file=sys.stderr)
         return 1
@@ -1046,6 +1050,7 @@ def run_serve(args) -> int:
         pool_blocks=args.pool_blocks or None,
         prefix_cache=args.prefix_cache,
         prefill_chunk=args.prefill_chunk,
+        kv_quant=args.kv_quant,
         spec_k=args.spec_k,
         spec_ngram=args.spec_ngram,
         spec_min_accept=args.spec_min_accept,
@@ -1186,6 +1191,14 @@ def run_loadgen(args) -> int:
     if args.spec_k < 0:
         print(f"--spec-k must be >= 0, got {args.spec_k}", file=sys.stderr)
         return 1
+    if args.block_size < 0:
+        print(f"--block-size must be >= 0, got {args.block_size}",
+              file=sys.stderr)
+        return 1
+    if args.kv_quant != "off" and not args.block_size:
+        print("--kv-quant requires the paged KV cache (--block-size > 0)",
+              file=sys.stderr)
+        return 1
     if not (args.dryrun or args.workload_only or args.export_dir):
         print("error: need an EXPORT_DIR, --dryrun, or --workload-only",
               file=sys.stderr)
@@ -1254,6 +1267,10 @@ def run_loadgen(args) -> int:
 
     slots = args.slots or (4 if args.dryrun else 8)
     max_len = args.max_len or (96 if args.dryrun else 256)
+    if args.block_size and max_len % args.block_size != 0:
+        print(f"max length {max_len} must be a multiple of --block-size "
+              f"{args.block_size}", file=sys.stderr)
+        return 1
     need = loadgen.max_total_len(reqs)
     if need > max_len:
         print(
@@ -1283,6 +1300,7 @@ def run_loadgen(args) -> int:
         warm = ContinuousBatchingEngine(
             params, cfg, max_slots=slots, max_len=max_len,
             horizon=args.horizon, spec_k=args.spec_k,
+            block_size=args.block_size, kv_quant=args.kv_quant,
             metrics=ServingMetrics(registry=MetricsRegistry()),
         )
         for r in reqs:
@@ -1303,6 +1321,7 @@ def run_loadgen(args) -> int:
     engine = ContinuousBatchingEngine(
         params, cfg, max_slots=slots, max_len=max_len,
         horizon=args.horizon, metrics=metrics, spec_k=args.spec_k,
+        block_size=args.block_size, kv_quant=args.kv_quant,
     )
     cmap = spec.class_map()
     t0 = time.monotonic()
@@ -1324,10 +1343,14 @@ def run_loadgen(args) -> int:
         slo.request_records(metrics), cmap, res["wall_s"]
     )
     report["steps"] = res["steps"]
+    # total emitted tokens: the figure the kvq CI phase compares across
+    # --kv-quant configs (quantization must not change termination)
+    report["tokens_out"] = metrics.snapshot().get("tokens_out", 0.0)
     report["workload"] = {
         "seed": spec.seed, "arrival": spec.arrival,
         "rate_rps": spec.rate_rps, "requests": len(reqs),
         "speed": args.speed,
+        "block_size": args.block_size, "kv_quant": args.kv_quant,
     }
     if args.spec_k > 0:
         # the speculative figures the CI gate and bench rungs read:
@@ -2237,6 +2260,17 @@ def build_parser() -> argparse.ArgumentParser:
         "(0 = single-dispatch prefill)",
     )
     sv.add_argument(
+        "--kv-quant", choices=("off", "int8", "int4"), default="off",
+        help="paged KV cache: store K/V quantized per block (int8, or "
+        "packed int4) with per-block-per-head f32 scales — decode "
+        "moves 2-4x fewer cache bytes and the same HBM holds 2-4x the "
+        "resident tokens. Requires --block-size > 0. Greedy outputs "
+        "are NOT bit-identical to bf16 KV (use the default 'off' for "
+        "the identity lane); quality is gated live via the spec-"
+        "decoding acceptance EMA (edl_kv_quant_quality_ok) when "
+        "--spec-k > 0",
+    )
+    sv.add_argument(
         "--spec-k", type=int, default=0,
         help="speculative decoding: draft tokens verified per decode "
         "dispatch (0 = off). The host n-gram drafter proposes up to K "
@@ -2384,6 +2418,16 @@ def build_parser() -> argparse.ArgumentParser:
     lg.add_argument(
         "--max-len", type=int, default=0,
         help="tokens per KV slot (0 = auto: 96 dryrun, 256 export)",
+    )
+    lg.add_argument(
+        "--block-size", type=int, default=0,
+        help="paged KV cache for the replay engine, as in `edl serve "
+        "--block-size` (0 = contiguous; must divide the max length)",
+    )
+    lg.add_argument(
+        "--kv-quant", choices=("off", "int8", "int4"), default="off",
+        help="quantized paged KV for the replay engine, as in `edl "
+        "serve --kv-quant`. Requires --block-size > 0",
     )
     lg.add_argument("--horizon", type=int, default=4)
     lg.add_argument(

@@ -780,6 +780,154 @@ def decode_horizon_slots(
 # a SCRATCH block: inactive/frozen lanes and prompt-bucket padding
 # route their writes there, and no live table entry ever maps to it,
 # so colliding scratch writes are never read back.
+#
+# -- paged KV quantization (kv_quant = "int8" | "int4") ----------------------
+#
+# Decode is KV-bandwidth-bound once weights are int8 (BENCH_r05: b=1
+# at ~99.5% of peak HBM BW), so the paged pool can optionally store
+# QUANTIZED K/V: int8 (or packed int4) values with per-block-per-kv-
+# head f32 absmax scales — the fixed-size block is the quantization
+# unit, which is what lets quantization compose with refcounted CoW
+# prefix sharing (a block copy carries its scale entry with it).
+#
+# The dequantize follows ``_matw``'s int8-weight discipline: the scale
+# never touches the contraction —
+#
+# * K side: the scale is constant along the contracted ``hd`` axis, so
+#   ``scores = einsum(q, kq.astype(dt)) * ks`` — XLA fuses the
+#   convert(int8→dt) into the operand read and HBM streams int8 bytes;
+#   the f32 scale multiply lands on the [.., S] scores, not on a
+#   dequantized [S, KV, hd] temp;
+# * V side: the scale varies along the contracted ``s`` axis but is
+#   indexed exactly like the softmax probs, so it folds into them:
+#   ``o = einsum((probs * vs).astype(dt), vq.astype(dt))``.
+#
+# Writes quantize ON THE FLY inside the same program that computes the
+# fresh K/V (decode lanes, verify lanes, prefill chunks — one shared
+# scatter discipline, :func:`_kvq_store`): per dispatch, each written
+# block's scale is grown to cover the new values' absmax (scatter-max),
+# RESET when the write lands at block offset 0 (a block's first write
+# is always offset 0 — decode crosses boundaries at offset 0, prefill
+# starts block-aligned, and the CoW full-hit rewrite targets the last
+# offset of a COPIED block that brought its scale along), and resident
+# block content is rescaled under the grown scale so earlier tokens
+# stay consistent. Scales only grow between resets, so the rescale
+# ratio is <= 1 and an idempotent frozen-lane rewrite is exact
+# (ratio 1). Exact greedy token identity cannot survive quantization;
+# the serving engine keeps ``kv_quant="off"`` byte-identical to the
+# unquantized path (these branches are trace-time, the "off" programs
+# and memo keys are untouched) and gates the quantized path on output
+# tolerance + the speculative acceptance EMA (engine-side).
+
+_KVQ_QMAX = {"int8": 127.0, "int4": 7.0}
+
+
+def kvq_packed_head_dim(kv_quant: str, head_dim: int) -> int:
+    """Innermost stored dim of one pool entry: int4 packs two 4-bit
+    values per int8 byte along ``hd`` (requires even head_dim)."""
+    if kv_quant == "int4":
+        if head_dim % 2:
+            raise ValueError(
+                f"kv_quant int4 needs an even head_dim, got {head_dim}"
+            )
+        return head_dim // 2
+    return head_dim
+
+
+def _kvq_pack(q: jnp.ndarray, kv_quant: str) -> jnp.ndarray:
+    """Rounded/clipped quantized values (f32 in [-qmax, qmax]) ->
+    stored int8. int4 packs index pairs along the last axis: even
+    index = low nibble, odd = high nibble."""
+    qi = q.astype(jnp.int32)
+    if kv_quant == "int8":
+        return qi.astype(jnp.int8)
+    lo = qi[..., 0::2]
+    hi = qi[..., 1::2]
+    return ((hi << 4) | (lo & 0xF)).astype(jnp.int8)
+
+
+def _kvq_unpack(p: jnp.ndarray, kv_quant: str) -> jnp.ndarray:
+    """Stored int8 -> quantized values as f32 in [-qmax, qmax]."""
+    if kv_quant == "int8":
+        return p.astype(jnp.float32)
+    x = p.astype(jnp.int32)
+    hi = x >> 4  # arithmetic shift sign-extends the high nibble
+    lo = ((x & 0xF) ^ 8) - 8  # sign-extend the low nibble
+    both = jnp.stack([lo, hi], axis=-1)  # [..., hd/2, 2]
+    return both.reshape(*p.shape[:-1], p.shape[-1] * 2).astype(
+        jnp.float32
+    )
+
+
+def _kvq_store(
+    pool: jnp.ndarray,
+    scale: jnp.ndarray,
+    i: int,
+    wblk: jnp.ndarray,
+    woff: jnp.ndarray,
+    new: jnp.ndarray,
+    kv_quant: str,
+):
+    """Quantize ``new`` [N, KV, hd] lane writes into layer ``i`` of the
+    packed ``pool`` [L, nb, bs, KV, hdp] at (``wblk``, ``woff``) [N],
+    maintaining per-(block, kv-head) f32 ``scale`` [L, nb, KV].
+
+    Per dispatch: (1) scatter-max the new values' absmax into per-block
+    scale proposals; (2) a write at offset 0 marks its block FRESH —
+    the scale resets instead of inheriting a freed previous tenant's
+    (a block's first real write is always offset 0, see the section
+    comment); (3) touched blocks' resident content is rescaled under
+    the grown scale (gather-modify-scatter of the written blocks only;
+    duplicate block indices carry identical payloads, so the scatter is
+    deterministic; fresh blocks' stale content is zeroed); (4) the new
+    values quantize under the final scale and land at their offsets.
+    Only refcount-1 blocks are ever written (the engine copy-on-writes
+    shared blocks first), so no two rows contend for one block — except
+    SCRATCH, whose content and scale are never read."""
+    qmax = _KVQ_QMAX[kv_quant]
+    newf = new.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(newf), axis=-1)  # [N, KV]
+    nb = scale.shape[1]
+    s_old = scale[i]  # [nb, KV]
+    prop = jnp.zeros_like(s_old).at[wblk].max(amax)
+    fresh = (
+        jnp.zeros((nb,), jnp.int32)
+        .at[wblk]
+        .max((woff == 0).astype(jnp.int32))
+        > 0
+    )
+    touched = jnp.zeros((nb,), bool).at[wblk].set(True)
+    s_new = jnp.maximum(
+        jnp.where(fresh[:, None], 0.0, s_old), prop / qmax
+    )
+    s_new = jnp.where(touched[:, None], s_new, s_old)
+    s_safe = jnp.where(s_new > 0.0, s_new, 1.0)
+    # resident-content rescale: exact identity (ratio 1) when the scale
+    # did not move; fresh blocks' stale previous-tenant content zeroes
+    ratio = (jnp.where(fresh[:, None], 0.0, s_old) / s_safe)[wblk]
+    cur = _kvq_unpack(pool[i][wblk], kv_quant)  # [N, bs, KV, hd]
+    resc = jnp.clip(
+        jnp.round(cur * ratio[:, None, :, None]), -qmax, qmax
+    )
+    pool = pool.at[i, wblk].set(_kvq_pack(resc, kv_quant))
+    qnew = jnp.clip(
+        jnp.round(newf / s_safe[wblk][:, :, None]), -qmax, qmax
+    )
+    pool = pool.at[i, wblk, woff].set(_kvq_pack(qnew, kv_quant))
+    scale = scale.at[i].set(s_new)
+    return pool, scale
+
+
+def _kvq_scale_strip(scale_i: jnp.ndarray, table: jnp.ndarray, bs: int):
+    """Per-position scale strip for the attention gather: gather the
+    [.., M, KV] block scales through the table and expand to
+    [.., KV, 1, 1, S], broadcastable against the ``bkgts`` score/prob
+    layout (block j's scale covers positions j*bs .. (j+1)*bs - 1)."""
+    sc = jnp.repeat(scale_i[table], bs, axis=-2)  # [.., S, KV]
+    sc = jnp.swapaxes(sc, -1, -2)  # [.., KV, S]
+    if sc.ndim == 2:  # single-slot table (prefill): add the batch axis
+        sc = sc[None]
+    return sc[:, :, None, None, :]
 
 
 def decode_step_slots_paged(
@@ -791,6 +939,9 @@ def decode_step_slots_paged(
     vc: jnp.ndarray,
     cfg: LlamaConfig,
     block_size: int,
+    kv_quant: str = "off",
+    ks: Optional[jnp.ndarray] = None,
+    vs: Optional[jnp.ndarray] = None,
 ):
     """One slot-decode step over the paged pool. tok/pos [B] int32;
     table [B, M] int32 physical block ids; kc/vc
@@ -805,7 +956,15 @@ def decode_step_slots_paged(
     it hides the contiguous cache's tail). Greedy output is therefore
     token-identical to the contiguous path whenever the engine's
     tables cover every written position — the contract
-    tests/test_paged_kv.py pins at H ∈ {1, 4, 16}."""
+    tests/test_paged_kv.py pins at H ∈ {1, 4, 16}.
+
+    ``kv_quant`` != "off" switches the pool to quantized storage (int8
+    or packed int4 entries + per-block-per-kv-head f32 scales ``ks``/
+    ``vs`` [L, nb, KV], see the section comment): lane writes quantize
+    on the fly, the gather dequantizes via the factored scale multiply,
+    and the returned tuple grows ``(ks, vs)``. The "off" path is
+    byte-identical to before the knob existed — the branch is
+    trace-time."""
     b = tok.shape[0]
     h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     groups = h // kvh
@@ -813,6 +972,7 @@ def decode_step_slots_paged(
     m = table.shape[1]
     s = m * bs
     rows = jnp.arange(b)
+    quant = kv_quant != "off"
     # rows whose pos ran past the table (a frozen lane parked one past
     # its last token, or a stale lane the host stopped tracking) write
     # to the scratch block — a clamped gather would alias the LAST real
@@ -828,22 +988,47 @@ def decode_step_slots_paged(
         dt = x.dtype
         a = _rmsnorm(x, lp["ln1"], cfg.norm_eps)
         q, knew, vnew = _qkv(cfg, a, lp, pos[:, None])
-        kc = kc.at[i, blk, off].set(knew[:, 0])
-        vc = vc.at[i, blk, off].set(vnew[:, 0])
-        # table gather: [n_blocks, bs, KV, hd][table] -> the row's
-        # logical [B, M, bs, KV, hd] view, flattened to [B, S, KV, hd]
-        kci = kc[i][table].reshape(b, s, kvh, hd)
-        vci = vc[i][table].reshape(b, s, kvh, hd)
+        if quant:
+            kc, ks = _kvq_store(kc, ks, i, blk, off, knew[:, 0], kv_quant)
+            vc, vs = _kvq_store(vc, vs, i, blk, off, vnew[:, 0], kv_quant)
+            kci = _kvq_unpack(kc[i][table], kv_quant).reshape(
+                b, s, kvh, hd
+            ).astype(dt)
+            vci = _kvq_unpack(vc[i][table], kv_quant).reshape(
+                b, s, kvh, hd
+            ).astype(dt)
+            ksc = _kvq_scale_strip(ks[i], table, bs)  # [B, KV, 1, 1, S]
+            vsc = _kvq_scale_strip(vs[i], table, bs)
+        else:
+            kc = kc.at[i, blk, off].set(knew[:, 0])
+            vc = vc.at[i, blk, off].set(vnew[:, 0])
+            # table gather: [n_blocks, bs, KV, hd][table] -> the row's
+            # logical [B, M, bs, KV, hd] view, flat to [B, S, KV, hd]
+            kci = kc[i][table].reshape(b, s, kvh, hd)
+            vci = vc[i][table].reshape(b, s, kvh, hd)
         qg = q.reshape(b, 1, kvh, groups, hd)
         scores = jnp.einsum("btkgd,bskd->bkgts", qg, kci) / np.sqrt(hd)
+        if quant:
+            # the K-side dequant: per-(block, kv-head) scale lands on
+            # the f32 scores (constant along the contracted hd axis),
+            # never on a dequantized [S, KV, hd] temp — _matw's
+            # discipline, the f32 multiply included
+            scores = scores.astype(jnp.float32) * ksc
         mask = (jnp.arange(s)[None, :] <= pos[:, None])[:, None, None, None, :]
         scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
-        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dt)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+        if quant:
+            # the V-side dequant folds into the probs (scale varies
+            # along the contracted s axis but indexes like the probs)
+            probs = probs * vsc
+        probs = probs.astype(dt)
         o = jnp.einsum("bkgts,bskd->btkgd", probs, vci).reshape(b, 1, h * hd)
         x = x + _matw(o, lp["wo"])
         x = _mlp(cfg, x, lp)
     x = _rmsnorm(x, params["ln_f"], cfg.norm_eps)
     logits = _matw(x[:, 0], params["lm_head"]).astype(jnp.float32)
+    if quant:
+        return logits, kc, vc, ks, vs
     return logits, kc, vc
 
 
@@ -863,6 +1048,9 @@ def decode_horizon_slots_paged(
     key: Optional[jax.Array] = None,
     temperature=None,
     sampling: bool = False,
+    kv_quant: str = "off",
+    ks: Optional[jnp.ndarray] = None,
+    vs: Optional[jnp.ndarray] = None,
 ):
     """The paged twin of :func:`decode_horizon_slots`: a fused horizon
     of ``horizon`` :func:`decode_step_slots_paged` steps with the SAME
@@ -870,13 +1058,24 @@ def decode_horizon_slots_paged(
     frozen position idempotently, and never disturb other rows). The
     block table is READ-ONLY across the horizon — the engine covers
     every position the horizon can write before dispatching, so no
-    mid-horizon allocation is ever needed on device."""
+    mid-horizon allocation is ever needed on device.
+
+    Under ``kv_quant`` != "off" the scan carry grows the scale planes
+    and the return tuple ends in ``(..., kc, vc, ks, vs)``."""
+    quant = kv_quant != "off"
 
     def step(carry, k):
-        tok, pos, active, rem, kc, vc = carry
-        logits, kc, vc = decode_step_slots_paged(
-            params, tok, pos, table, kc, vc, cfg, block_size
-        )
+        if quant:
+            tok, pos, active, rem, kc, vc, ks, vs = carry
+            logits, kc, vc, ks, vs = decode_step_slots_paged(
+                params, tok, pos, table, kc, vc, cfg, block_size,
+                kv_quant=kv_quant, ks=ks, vs=vs,
+            )
+        else:
+            tok, pos, active, rem, kc, vc = carry
+            logits, kc, vc = decode_step_slots_paged(
+                params, tok, pos, table, kc, vc, cfg, block_size
+            )
         if sampling:
             nxt = jax.random.categorical(k, logits / temperature, axis=-1)
         else:
@@ -887,11 +1086,20 @@ def decode_horizon_slots_paged(
         rem = jnp.where(active, rem - 1, rem)
         hit = active & (eosv >= 0) & (nxt == eosv)
         active = active & ~hit & (rem > 0)
+        if quant:
+            return (nxt, pos, active, rem, kc, vc, ks, vs), out
         return (nxt, pos, active, rem, kc, vc), out
 
     keys = jax.random.split(
         key if key is not None else jax.random.PRNGKey(0), horizon
     )
+    if quant:
+        (tok, pos, active, rem, kc, vc, ks, vs), outs = jax.lax.scan(
+            step, (tok, pos, active, rem, kc, vc, ks, vs), keys
+        )
+        return (
+            jnp.swapaxes(outs, 0, 1), tok, pos, active, rem, kc, vc, ks, vs
+        )
     (tok, pos, active, rem, kc, vc), outs = jax.lax.scan(
         step, (tok, pos, active, rem, kc, vc), keys
     )
@@ -908,6 +1116,9 @@ def prefill_paged(
     vc: jnp.ndarray,
     cfg: LlamaConfig,
     block_size: int,
+    kv_quant: str = "off",
+    ks: Optional[jnp.ndarray] = None,
+    vs: Optional[jnp.ndarray] = None,
 ):
     """Prefill one CHUNK of one slot's prompt into the paged pool.
 
@@ -927,7 +1138,12 @@ def prefill_paged(
     chunk's own K/V because the scatter lands before the gather. Pad
     tokens (t > last) write to the scratch block (never read) and
     their query rows are discarded by the caller taking ``last``'s
-    logits only."""
+    logits only.
+
+    Under ``kv_quant`` != "off" the whole chunk quantizes on the fly
+    (one :func:`_kvq_store` per layer per plane — the chunk's writes to
+    a block land together, so its scale converges in one step) and the
+    return tuple grows ``(ks, vs)``."""
     b, tb = tokens.shape
     h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     groups = h // kvh
@@ -943,6 +1159,7 @@ def prefill_paged(
         real, table[jnp.clip(positions // bs, 0, m - 1)], 0
     )
     woff = jnp.where(real, positions % bs, 0)
+    quant = kv_quant != "off"
     x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
     qmask = (jnp.arange(s)[None, :] <= positions[:, None])[
         None, None, None, :, :
@@ -952,20 +1169,39 @@ def prefill_paged(
         dt = x.dtype
         a = _rmsnorm(x, lp["ln1"], cfg.norm_eps)
         q, knew, vnew = _qkv(cfg, a, lp, positions)
-        kc = kc.at[i, wblk, woff].set(knew[0])
-        vc = vc.at[i, wblk, woff].set(vnew[0])
-        kci = kc[i][table].reshape(1, s, kvh, hd)
-        vci = vc[i][table].reshape(1, s, kvh, hd)
+        if quant:
+            kc, ks = _kvq_store(kc, ks, i, wblk, woff, knew[0], kv_quant)
+            vc, vs = _kvq_store(vc, vs, i, wblk, woff, vnew[0], kv_quant)
+            kci = _kvq_unpack(kc[i][table], kv_quant).reshape(
+                1, s, kvh, hd
+            ).astype(dt)
+            vci = _kvq_unpack(vc[i][table], kv_quant).reshape(
+                1, s, kvh, hd
+            ).astype(dt)
+            ksc = _kvq_scale_strip(ks[i], table, bs)
+            vsc = _kvq_scale_strip(vs[i], table, bs)
+        else:
+            kc = kc.at[i, wblk, woff].set(knew[0])
+            vc = vc.at[i, wblk, woff].set(vnew[0])
+            kci = kc[i][table].reshape(1, s, kvh, hd)
+            vci = vc[i][table].reshape(1, s, kvh, hd)
         qg = q.reshape(b, tb, kvh, groups, hd)
         scores = jnp.einsum("btkgd,bskd->bkgts", qg, kci) / np.sqrt(hd)
+        if quant:
+            scores = scores.astype(jnp.float32) * ksc
         scores = jnp.where(qmask, scores, jnp.finfo(scores.dtype).min)
-        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dt)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+        if quant:
+            probs = probs * vsc
+        probs = probs.astype(dt)
         o = jnp.einsum("bkgts,bskd->btkgd", probs, vci).reshape(b, tb, h * hd)
         x = x + _matw(o, lp["wo"])
         x = _mlp(cfg, x, lp)
     x = _rmsnorm(x, params["ln_f"], cfg.norm_eps)
     xl = x[jnp.arange(b), last]  # [1, d] — the chunk's last real token
     logits = _matw(xl, params["lm_head"]).astype(jnp.float32)
+    if quant:
+        return logits, kc, vc, ks, vs
     return logits, kc, vc
 
 
@@ -1133,6 +1369,9 @@ def verify_step_slots_paged(
     vc: jnp.ndarray,
     cfg: LlamaConfig,
     block_size: int,
+    kv_quant: str = "off",
+    ks: Optional[jnp.ndarray] = None,
+    vs: Optional[jnp.ndarray] = None,
 ):
     """The paged twin of :func:`verify_step_slots`: K = D+1 query lanes
     per row routed through the [B, M] block table, same on-device
@@ -1143,7 +1382,13 @@ def verify_step_slots_paged(
     dispatching (``_ensure_cover`` sized to max(horizon, K)), so
     committed lanes always land in mapped private blocks — uncovered
     garbage from rejected lanes dies in scratch or is overwritten
-    before its position is ever unmasked."""
+    before its position is ever unmasked.
+
+    Under ``kv_quant`` != "off" the [B, K] lane writes flatten into one
+    :func:`_kvq_store` per plane per layer (rejected-lane garbage can
+    only GROW a resident block's scale — a monotone rescale, never a
+    corruption; the garbage values themselves are overwritten before
+    their positions unmask) and the return tuple grows ``(ks, vs)``."""
     b, d = draft.shape
     k = d + 1
     h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
@@ -1161,6 +1406,7 @@ def verify_step_slots_paged(
         inb, table[rows[:, None], jnp.clip(qpos // bs, 0, m - 1)], 0
     )
     woff = jnp.where(inb, qpos % bs, 0)
+    quant = kv_quant != "off"
     x = jnp.take(params["embed"], toks, axis=0).astype(cfg.dtype)
     qmask = (jnp.arange(s)[None, None, :] <= qpos[:, :, None])[
         :, None, None, :, :
@@ -1170,21 +1416,47 @@ def verify_step_slots_paged(
         dt = x.dtype
         a = _rmsnorm(x, lp["ln1"], cfg.norm_eps)
         q, knew, vnew = _qkv(cfg, a, lp, qpos)
-        kc = kc.at[i, wblk, woff].set(knew)
-        vc = vc.at[i, wblk, woff].set(vnew)
-        kci = kc[i][table].reshape(b, s, kvh, hd)
-        vci = vc[i][table].reshape(b, s, kvh, hd)
+        if quant:
+            kc, ks = _kvq_store(
+                kc, ks, i, wblk.reshape(-1), woff.reshape(-1),
+                knew.reshape(b * k, kvh, hd), kv_quant,
+            )
+            vc, vs = _kvq_store(
+                vc, vs, i, wblk.reshape(-1), woff.reshape(-1),
+                vnew.reshape(b * k, kvh, hd), kv_quant,
+            )
+            kci = _kvq_unpack(kc[i][table], kv_quant).reshape(
+                b, s, kvh, hd
+            ).astype(dt)
+            vci = _kvq_unpack(vc[i][table], kv_quant).reshape(
+                b, s, kvh, hd
+            ).astype(dt)
+            ksc = _kvq_scale_strip(ks[i], table, bs)
+            vsc = _kvq_scale_strip(vs[i], table, bs)
+        else:
+            kc = kc.at[i, wblk, woff].set(knew)
+            vc = vc.at[i, wblk, woff].set(vnew)
+            kci = kc[i][table].reshape(b, s, kvh, hd)
+            vci = vc[i][table].reshape(b, s, kvh, hd)
         qg = q.reshape(b, k, kvh, groups, hd)
         scores = jnp.einsum("btkgd,bskd->bkgts", qg, kci) / np.sqrt(hd)
+        if quant:
+            scores = scores.astype(jnp.float32) * ksc
         scores = jnp.where(qmask, scores, jnp.finfo(scores.dtype).min)
-        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dt)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+        if quant:
+            probs = probs * vsc
+        probs = probs.astype(dt)
         o = jnp.einsum("bkgts,bskd->btkgd", probs, vci).reshape(b, k, h * hd)
         x = x + _matw(o, lp["wo"])
         x = _mlp(cfg, x, lp)
     x = _rmsnorm(x, params["ln_f"], cfg.norm_eps)
     logits = _matw(x, params["lm_head"]).astype(jnp.float32)
     out = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    return _spec_accept(tok, draft, out, pos, active, rem, eosv, kc, vc)
+    acc = _spec_accept(tok, draft, out, pos, active, rem, eosv, kc, vc)
+    if quant:
+        return acc + (ks, vs)
+    return acc
 
 
 def generate(

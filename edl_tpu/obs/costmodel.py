@@ -216,15 +216,16 @@ def param_bytes(cfg, bytes_per_param: int = 2) -> float:
 
 
 def kv_cache_bytes(
-    cfg, slots: int, max_len: int, bytes_per_el: int = 2
+    cfg, slots: int, max_len: int, bytes_per_el: float = 2
 ) -> float:
-    """The [L, slots, max_len, KV, hd] K + V cache pair."""
+    """The [L, slots, max_len, KV, hd] K + V cache pair.
+    ``bytes_per_el`` may be fractional (packed int4 KV = 0.5)."""
     d, h, kv, hd, ff, L, V = _dims(cfg)
     return 2.0 * L * slots * max_len * kv * hd * bytes_per_el
 
 
 def kv_pool_bytes(
-    cfg, n_blocks: int, block_size: int, bytes_per_el: int = 2
+    cfg, n_blocks: int, block_size: int, bytes_per_el: float = 2
 ) -> float:
     """The paged [L, n_blocks, block_size, KV, hd] K + V pool pair
     (includes the reserved scratch block — it occupies real HBM)."""
@@ -232,17 +233,45 @@ def kv_pool_bytes(
     return 2.0 * L * n_blocks * block_size * kv * hd * bytes_per_el
 
 
+def kv_quant_bytes_per_el(kv_quant: str) -> float:
+    """KV pool bytes per logical element for a serving ``--kv-quant``
+    mode: bf16 2, int8 1, packed int4 0.5."""
+    return {"off": 2.0, "int8": 1.0, "int4": 0.5}[kv_quant]
+
+
+def kv_scale_bytes(cfg, slots: int, s_pad: int, kv_block_size: int) -> float:
+    """Bytes of the per-block-per-kv-head f32 scale planes a quantized
+    decode step reads alongside the values: K + V planes, one f32 per
+    (layer, block, kv head) over ``ceil(s_pad / block)`` blocks per
+    slot. Zero when ``kv_block_size`` is 0 (unquantized — no scales)."""
+    if kv_block_size <= 0:
+        return 0.0
+    d, h, kv, hd, ff, L, V = _dims(cfg)
+    blocks = -(-s_pad // kv_block_size)
+    return 2.0 * L * slots * blocks * kv * 4.0
+
+
 def decode_step_bytes(
     cfg, param_bytes_total: float, b: int, s_pad: int,
-    kv_bytes_per_el: int = 2,
+    kv_bytes_per_el: float = 2, kv_block_size: int = 0,
 ) -> float:
     """HBM bytes one decode step must move: every parameter byte
     (weights stream once per token — the defining cost of small-batch
     decode) plus the FULL padded KV cache (the masked-dense decode
     attention reads all S slots every step, by construction).
     Activation traffic at B<=32 is noise next to these two. The exact
-    formula ``bench.py`` publishes ``decode_pct_peak_bw`` with."""
-    return param_bytes_total + kv_cache_bytes(cfg, b, s_pad, kv_bytes_per_el)
+    formula ``bench.py`` publishes ``decode_pct_peak_bw`` with.
+
+    Quantized paged KV narrows the cache term (``kv_bytes_per_el`` 1
+    for int8, 0.5 for packed int4) and adds the per-block f32 scale
+    strips the gather reads — pass the paged ``kv_block_size`` so the
+    scale term is priced honestly (it is ~1/(2·bs) of the values for
+    int8, small but not zero)."""
+    return (
+        param_bytes_total
+        + kv_cache_bytes(cfg, b, s_pad, kv_bytes_per_el)
+        + kv_scale_bytes(cfg, b, s_pad, kv_block_size)
+    )
 
 
 def train_step_bytes(cfg, tokens_per_step: int,
@@ -293,14 +322,21 @@ class CostModel:
     """A config + device peak bound together: per-phase costs and the
     achieved/peak ratios. ``param_bytes_total`` should be the ACTUAL
     loaded tree's bytes when known (int8 records halve it — the ledger
-    measures, the model predicts), else the bf16 estimate is used."""
+    measures, the model predicts), else the bf16 estimate is used.
+    ``kv_bytes_per_el``/``kv_block_size`` describe the KV pool the
+    decode programs actually read: a quantized paged engine passes
+    (1, block_size) for int8 KV or (0.5, block_size) for int4, which
+    narrows the cache term and adds the f32 scale strips — keeping
+    the live ``edl_bw_util_ratio{phase="decode"}`` truthful when the
+    cache shrinks."""
 
     def __init__(
         self,
         cfg,
         peak: Optional[DevicePeak] = None,
         param_bytes_total: Optional[float] = None,
-        kv_bytes_per_el: int = 2,
+        kv_bytes_per_el: float = 2,
+        kv_block_size: int = 0,
     ):
         self.cfg = cfg
         self.peak = peak or detect_peak()
@@ -310,6 +346,7 @@ class CostModel:
             else param_bytes(cfg)
         )
         self.kv_bytes_per_el = kv_bytes_per_el
+        self.kv_block_size = int(kv_block_size)
 
     def train_step(self, batch: int, seq: int) -> Cost:
         toks = batch * seq
@@ -331,7 +368,8 @@ class CostModel:
         ``b`` rows (frozen rows still compute — program cost) at the
         full padded context."""
         step_bytes = decode_step_bytes(
-            self.cfg, self.param_bytes, b, s_pad, self.kv_bytes_per_el
+            self.cfg, self.param_bytes, b, s_pad, self.kv_bytes_per_el,
+            self.kv_block_size,
         )
         return Cost(
             flops=horizon * b * decode_flops_per_token(self.cfg, s_pad),
@@ -347,7 +385,8 @@ class CostModel:
         speculation on a bandwidth-bound decode: accepted-tokens/
         dispatch > 1 multiplies tokens per byte moved."""
         step_bytes = decode_step_bytes(
-            self.cfg, self.param_bytes, b, s_pad, self.kv_bytes_per_el
+            self.cfg, self.param_bytes, b, s_pad, self.kv_bytes_per_el,
+            self.kv_block_size,
         )
         return Cost(
             flops=k * b * decode_flops_per_token(self.cfg, s_pad),

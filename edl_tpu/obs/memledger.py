@@ -89,6 +89,15 @@ class MemoryLedger:
             "free KV pool blocks across registered paged engines — the "
             "headroom admission gates on",
         )
+        # owner -> (pool bytes incl. scales, capacity tokens): the
+        # quantized-KV shrink, scrapeable as bytes per resident token
+        self._kv_bpt: Dict[str, Tuple[int, int]] = {}
+        self._g_kv_bpt = r.gauge(
+            "edl_kv_bytes_per_token",
+            "KV pool bytes (values + quantization scales) per token of "
+            "pool capacity across registered paged engines — 2-4x lower "
+            "under --kv-quant int8/int4",
+        )
         self._c_prefix_hits = r.counter(
             "edl_kv_prefix_hit_total",
             "prefix-cache block hits: prompt blocks served from the "
@@ -154,14 +163,18 @@ class MemoryLedger:
                 touched.add(cat)
             self._kv_usage.pop(owner, None)
             self._kv_blocks_free.pop(owner, None)
+            self._kv_bpt.pop(owner, None)
             totals = {c: self._by_category.get(c, 0) for c in touched}
             used = sum(u for u, _ in self._kv_usage.values())
             cap = sum(c for _, c in self._kv_usage.values())
             free = sum(self._kv_blocks_free.values())
+            bpt_b = sum(b for b, _ in self._kv_bpt.values())
+            bpt_t = sum(t for _, t in self._kv_bpt.values())
         for c, v in totals.items():
             self._g_bytes.set(v, category=c)
         self._g_kv_occ.set(used / cap if cap else 0.0)
         self._g_kv_free.set(free)
+        self._g_kv_bpt.set(bpt_b / bpt_t if bpt_t else 0.0)
         return released
 
     # -- KV occupancy -------------------------------------------------------
@@ -183,6 +196,18 @@ class MemoryLedger:
             self._kv_blocks_free[owner] = int(free_blocks)
             total = sum(self._kv_blocks_free.values())
         self._g_kv_free.set(total)
+
+    def set_kv_bytes_per_token(
+        self, owner: str, pool_bytes: int, capacity_tokens: int
+    ) -> None:
+        """One paged engine's pool bytes (values + scales) over its
+        token capacity; the gauge publishes the byte-weighted average
+        across engines — the figure ``--kv-quant`` shrinks 2-4x."""
+        with self._lock:
+            self._kv_bpt[owner] = (int(pool_bytes), int(capacity_tokens))
+            b = sum(x for x, _ in self._kv_bpt.values())
+            t = sum(y for _, y in self._kv_bpt.values())
+        self._g_kv_bpt.set(b / t if t else 0.0)
 
     def count_prefix_hits(self, n: int = 1) -> None:
         """Count ``n`` prompt blocks served from the shared prefix

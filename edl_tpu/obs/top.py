@@ -156,10 +156,12 @@ def summarize(fams: _Fams) -> List[str]:
             gb = lambda v: f"{v / (1 << 30):.2f}G"  # noqa: E731
             occ = _total(fams, "edl_kv_occupancy_ratio")
             compiles = _total(fams, "edl_compiles_total")
+            kv_bpt = _total(fams, "edl_kv_bytes_per_token")
             lines.append(
                 "         hbm: "
                 + " ".join(f"{c}={gb(v)}" for c, v in sorted(hbm.items()))
                 + (f"  kv_used={occ:.1%}" if occ else "")
+                + (f"  kv_B/tok={kv_bpt:.2f}" if kv_bpt else "")
                 + (f"  compiles={compiles:.0f}" if compiles else "")
             )
 

@@ -359,6 +359,198 @@ def _verify_program_paged(
     return _memo(("verify-paged", cfg, b, nb, m, bs, d), make)
 
 
+# -- quantized-KV program twins (kv_quant != "off") --------------------------
+#
+# Separate factories under separate memo keys, NOT a parameter on the
+# existing ones: the off path's keys and traced programs must stay
+# byte-identical to pre-quantization behavior (tests pin the memo-key
+# set and dispatch counters). Each twin threads the per-block scale
+# planes ks/vs [L, nb, KV] through the donation contract exactly like
+# the pools — a stale scale reference is as unsafe as a stale pool.
+
+
+def _block_program_paged_q(
+    cfg: llama.LlamaConfig, b: int, nb: int, m: int, bs: int,
+    horizon: int, sampling: bool, kv_quant: str,
+):
+    """Quantized-KV twin of :func:`_block_program_paged`: the pools are
+    int8 (packed int4 under the same dtype) and the carries grow the
+    scale planes, donated alongside them."""
+
+    def make():
+        @partial(jax.jit, donate_argnums=(1, 2, 3, 4, 7, 8, 9, 10))
+        def run(params, tok, pos, active, rem, eosv, table, kc, vc, ks, vs,
+                key, temperature):
+            return llama.decode_horizon_slots_paged(
+                params, tok, pos, active, rem, eosv, table, kc, vc, cfg,
+                block_size=bs, horizon=horizon, key=key,
+                temperature=temperature, sampling=sampling,
+                kv_quant=kv_quant, ks=ks, vs=vs,
+            )
+
+        return compilewatch.wrap(run, "serve.block")
+
+    return _memo(
+        ("block-paged-q", kv_quant, cfg, b, nb, m, bs, horizon, sampling),
+        make,
+    )
+
+
+def _prefill_paged_program_q(
+    cfg: llama.LlamaConfig, tb: int, bs: int, sampling: bool, kv_quant: str
+):
+    """Quantized-KV twin of :func:`_prefill_paged_program`."""
+
+    def make():
+        @partial(jax.jit, donate_argnums=(7, 8, 9, 10, 11, 12, 13, 14, 15))
+        def run(params, tokens, start, last, slot, max_new, eos,
+                tok, pos, active, rem, eosv, kc, vc, ks, vs, table,
+                key, temperature):
+            logits, kc, vc, ks, vs = llama.prefill_paged(
+                params, tokens, start, last, table, kc, vc, cfg, bs,
+                kv_quant=kv_quant, ks=ks, vs=vs,
+            )
+            if sampling:
+                t0 = jax.random.categorical(key, logits / temperature, axis=-1)
+            else:
+                t0 = jnp.argmax(logits, axis=-1)
+            t0 = t0.astype(jnp.int32)[0]
+            tok = tok.at[slot].set(t0)
+            pos = pos.at[slot].set(start + last + 1)
+            hit = (eos >= 0) & (t0 == eos)
+            active = active.at[slot].set(~hit & (max_new > 1))
+            rem = rem.at[slot].set(jnp.maximum(max_new - 1, 0))
+            eosv = eosv.at[slot].set(eos)
+            return t0, tok, pos, active, rem, eosv, kc, vc, ks, vs
+
+        return compilewatch.wrap(run, "serve.prefill")
+
+    return _memo(("prefill-paged-q", kv_quant, cfg, tb, bs, sampling), make)
+
+
+def _prefill_chunk_program_q(
+    cfg: llama.LlamaConfig, c: int, bs: int, kv_quant: str
+):
+    """Quantized-KV twin of :func:`_prefill_chunk_program`."""
+
+    def make():
+        @partial(jax.jit, donate_argnums=(3, 4, 5, 6))
+        def run(params, tokens, start, kc, vc, ks, vs, table):
+            _, kc, vc, ks, vs = llama.prefill_paged(
+                params, tokens, start, jnp.int32(c - 1), table, kc, vc,
+                cfg, bs, kv_quant=kv_quant, ks=ks, vs=vs,
+            )
+            return kc, vc, ks, vs
+
+        return compilewatch.wrap(run, "serve.prefill")
+
+    return _memo(("prefill-chunk-q", kv_quant, cfg, c, bs), make)
+
+
+def _copy_block_program_q(
+    cfg: llama.LlamaConfig, nb: int, bs: int, kv_quant: str
+):
+    """Quantized-KV twin of :func:`_copy_block_program`: the CoW copy
+    must carry the block's SCALES with its values — a copied block
+    re-quantized under the wrong scale would silently rescale the
+    whole shared prefix."""
+
+    def make():
+        @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+        def run(kc, vc, ks, vs, src, dst):
+            kb = jax.lax.dynamic_slice_in_dim(kc, src, 1, axis=1)
+            vb = jax.lax.dynamic_slice_in_dim(vc, src, 1, axis=1)
+            kc = jax.lax.dynamic_update_slice_in_dim(kc, kb, dst, axis=1)
+            vc = jax.lax.dynamic_update_slice_in_dim(vc, vb, dst, axis=1)
+            ksb = jax.lax.dynamic_slice_in_dim(ks, src, 1, axis=1)
+            vsb = jax.lax.dynamic_slice_in_dim(vs, src, 1, axis=1)
+            ks = jax.lax.dynamic_update_slice_in_dim(ks, ksb, dst, axis=1)
+            vs = jax.lax.dynamic_update_slice_in_dim(vs, vsb, dst, axis=1)
+            return kc, vc, ks, vs
+
+        return compilewatch.wrap(run, "serve.block_copy")
+
+    return _memo(("blockcopy-q", kv_quant, cfg, nb, bs), make)
+
+
+def _verify_program_paged_q(
+    cfg: llama.LlamaConfig, b: int, nb: int, m: int, bs: int, d: int,
+    kv_quant: str,
+):
+    """Quantized-KV twin of :func:`_verify_program_paged`."""
+
+    def make():
+        @partial(jax.jit, donate_argnums=(1, 3, 4, 5, 8, 9, 10, 11))
+        def run(params, tok, draft, pos, active, rem, eosv, table,
+                kc, vc, ks, vs):
+            return llama.verify_step_slots_paged(
+                params, tok, draft, pos, active, rem, eosv, table, kc, vc,
+                cfg, block_size=bs, kv_quant=kv_quant, ks=ks, vs=vs,
+            )
+
+        return compilewatch.wrap(run, "serve.verify")
+
+    return _memo(("verify-paged-q", kv_quant, cfg, b, nb, m, bs, d), make)
+
+
+class SpecAcceptGuard:
+    """Live quality gate for the quantized-KV path: speculative
+    acceptance rate is a free, always-on probe of output quality (the
+    verifier's argmax IS the model's output — if quantization bends the
+    distribution, drafts stop matching and acceptance falls before any
+    offline eval would notice). The guard warms up a baseline from the
+    first ``warmup`` verify blocks, then freezes it and flags DEGRADED
+    when the acceptance EMA drops more than ``tol`` (absolute rate
+    points) below baseline. Publishes ``edl_kv_quant_quality_ok``
+    (1 healthy / 0 degraded) and emits a flight event once per
+    transition — an operator alarm, not an automatic fallback (the
+    identity lane is a restart away with ``--kv-quant off``)."""
+
+    def __init__(self, registry, *, warmup: int = 20, tol: float = 0.05,
+                 alpha: float = 0.1):
+        self.warmup = int(warmup)
+        self.tol = float(tol)
+        self.alpha = float(alpha)
+        self.baseline: Optional[float] = None
+        self.ema: Optional[float] = None
+        self.ok = True
+        self._seen = 0
+        self._acc_sum = 0.0
+        self._g_ok = registry.gauge(
+            "edl_kv_quant_quality_ok",
+            "1 while the quantized-KV spec-acceptance EMA holds its "
+            "warmed-up baseline, 0 after a degradation (serving/engine"
+            ".py SpecAcceptGuard)",
+        )
+        self._g_ok.set(1.0)
+
+    def observe(self, drafted: int, accepted: int) -> None:
+        """Feed one verify block's (drafted, accepted) counts."""
+        if drafted <= 0:
+            return
+        rate = accepted / drafted
+        self.ema = (
+            rate if self.ema is None
+            else (1 - self.alpha) * self.ema + self.alpha * rate
+        )
+        if self.baseline is None:
+            self._seen += 1
+            self._acc_sum += rate
+            if self._seen >= self.warmup:
+                self.baseline = self._acc_sum / self._seen
+            return
+        degraded = self.ema < self.baseline - self.tol
+        if degraded == self.ok:  # transition either way
+            self.ok = not degraded
+            self._g_ok.set(1.0 if self.ok else 0.0)
+            flight.emit(
+                "serve.kv_quant_quality",
+                severity="warn" if degraded else "info",
+                ok=self.ok, ema=round(self.ema, 4),
+                baseline=round(self.baseline, 4), tol=self.tol,
+            )
+
+
 @dataclass
 class _Slot:
     """Host-side state of one occupied KV slot. The device holds the
@@ -435,6 +627,7 @@ class ContinuousBatchingEngine:
         pool_blocks: Optional[int] = None,
         prefix_cache: bool = False,
         prefill_chunk: int = 0,
+        kv_quant: str = "off",
         spec_k: int = 0,
         spec_ngram: int = 3,
         spec_min_accept: float = 0.0,
@@ -499,6 +692,22 @@ class ContinuousBatchingEngine:
             )
         else:
             self._m = 0
+        # quantized paged KV (kv_quant != "off"): the pool stores int8
+        # (or packed int4) entries + per-block-per-kv-head f32 scales;
+        # decode moves 2-4x fewer cache bytes. "off" is the identity
+        # lane — byte-identical programs, no scale planes allocated.
+        if kv_quant not in ("off", "int8", "int4"):
+            raise ValueError(
+                f"kv_quant must be one of off/int8/int4, got {kv_quant!r}"
+            )
+        if kv_quant != "off":
+            if not self._paged:
+                raise ValueError(
+                    "kv_quant requires the paged KV cache (block_size > 0)"
+                )
+            # raises for int4 on odd head_dim (two lanes pack per byte)
+            llama.kvq_packed_head_dim(kv_quant, cfg.head_dim)
+        self.kv_quant = str(kv_quant)
         self.block_size = int(block_size)
         self.pool_blocks = int(pool_blocks) if self._paged else 0
         self.prefill_chunk = int(prefill_chunk)
@@ -546,6 +755,10 @@ class ContinuousBatchingEngine:
         self._cost = _cm.CostModel(
             cfg, peak=_cm.detect_peak(),
             param_bytes_total=pbytes or None,
+            kv_bytes_per_el=_cm.kv_quant_bytes_per_el(self.kv_quant),
+            kv_block_size=(
+                self.block_size if self.kv_quant != "off" else 0
+            ),
         )
         self._eff = _cm.EfficiencyMeter(
             self._cost.peak, registry=self.metrics.registry
@@ -567,6 +780,12 @@ class ContinuousBatchingEngine:
             _spec.SpecPolicy(min_accept=self.spec_min_accept)
             if self.spec_k > 0 else None
         )
+        # the quantized path's live quality gate: only meaningful when
+        # speculation provides the acceptance probe
+        self._kvq_guard = (
+            SpecAcceptGuard(self.metrics.registry)
+            if self.kv_quant != "off" and self.spec_k > 0 else None
+        )
         self._verify_cost = (
             self._cost.verify_block(max_slots, self.spec_k + 1, max_len)
             if self.spec_k > 0 else None
@@ -574,7 +793,15 @@ class ContinuousBatchingEngine:
         self._ledger.register(self._ledger_owner, "params", pbytes, "params")
         weakref.finalize(self, self._ledger.release_owner, self._ledger_owner)
         self._alloc_device_state()
-        if self._paged:
+        if self._paged and self.kv_quant != "off":
+            self._decode = _block_program_paged_q(
+                cfg, max_slots, self.pool_blocks, self._m,
+                self.block_size, horizon, self._sampling, self.kv_quant,
+            )
+            self._copyblk = _copy_block_program_q(
+                cfg, self.pool_blocks, self.block_size, self.kv_quant
+            )
+        elif self._paged:
             self._decode = _block_program_paged(
                 cfg, max_slots, self.pool_blocks, self._m,
                 self.block_size, horizon, self._sampling,
@@ -592,12 +819,21 @@ class ContinuousBatchingEngine:
             max_len=max_len,
             horizon=horizon,
             cache_mb=round(
-                (self._kc.nbytes + self._vc.nbytes) / 2**20, 1),
+                (self._kc.nbytes + self._vc.nbytes + self._kv_scale_nbytes())
+                / 2**20, 1),
             paged=self._paged,
             block_size=self.block_size,
             pool_blocks=self.pool_blocks,
+            kv_quant=self.kv_quant,
             sampling=self._sampling,
         )
+
+    def _kv_scale_nbytes(self) -> int:
+        """Bytes held by the quantized pool's scale planes (0 when
+        kv_quant is off — no planes exist)."""
+        if self._ks is None:
+            return 0
+        return self._ks.nbytes + self._vs.nbytes
 
     def _alloc_device_state(self) -> None:
         """(Re)allocate the device-side slot decode state — the block
@@ -614,6 +850,8 @@ class ContinuousBatchingEngine:
         self._drem = jnp.zeros(max_slots, jnp.int32)
         self._deos = jnp.full((max_slots,), -1, jnp.int32)
         L, kvh, hd = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+        self._ks: Optional[jnp.ndarray] = None
+        self._vs: Optional[jnp.ndarray] = None
         if self._paged:
             # block POOL, not slot slab — block 0 is SCRATCH (pads and
             # frozen/inactive lanes write there, nothing reads it). The
@@ -621,9 +859,21 @@ class ContinuousBatchingEngine:
             # rebuilt here from nothing: after a recovery the pool is
             # zeros, so every prior block (including cached prefixes)
             # is invalid and the re-prefill repopulates what it needs.
-            shape = (L, self.pool_blocks, self.block_size, kvh, hd)
-            self._kc = jnp.zeros(shape, cfg.dtype)
-            self._vc = jnp.zeros(shape, cfg.dtype)
+            if self.kv_quant != "off":
+                # quantized pool: int8 entries (int4 packs two per
+                # byte along head_dim) + per-block-per-kv-head f32
+                # scale planes for K and V. A zero scale decodes a
+                # zero block — the recovery realloc is self-consistent.
+                hdp = llama.kvq_packed_head_dim(self.kv_quant, hd)
+                shape = (L, self.pool_blocks, self.block_size, kvh, hdp)
+                self._kc = jnp.zeros(shape, jnp.int8)
+                self._vc = jnp.zeros(shape, jnp.int8)
+                self._ks = jnp.zeros((L, self.pool_blocks, kvh), jnp.float32)
+                self._vs = jnp.zeros((L, self.pool_blocks, kvh), jnp.float32)
+            else:
+                shape = (L, self.pool_blocks, self.block_size, kvh, hd)
+                self._kc = jnp.zeros(shape, cfg.dtype)
+                self._vc = jnp.zeros(shape, cfg.dtype)
             self._balloc = _paged.BlockAllocator(
                 self.pool_blocks, self.block_size
             )
@@ -661,8 +911,18 @@ class ContinuousBatchingEngine:
         # so discarded in-flight time is not charged
         self._ledger.register(
             self._ledger_owner, "kv",
-            self._kc.nbytes + self._vc.nbytes, "kv",
+            self._kc.nbytes + self._vc.nbytes + self._kv_scale_nbytes(),
+            "kv",
         )
+        if self._paged:
+            # scrapeable shrink: pool bytes (values + scales) over the
+            # pool's token capacity — 4.12 B/tok bf16 vs 2.12 int8 on
+            # the flagship shape (scales add ~1/(2·bs) back)
+            self._ledger.set_kv_bytes_per_token(
+                self._ledger_owner,
+                self._kc.nbytes + self._vc.nbytes + self._kv_scale_nbytes(),
+                self.pool_blocks * self.block_size,
+            )
         self._ledger.register(
             self._ledger_owner, "slot_state",
             self._dtok.nbytes + self._dpos.nbytes + self._dact.nbytes
@@ -998,6 +1258,8 @@ class ContinuousBatchingEngine:
             table = jnp.asarray(tbl)
         old = (self._dtok, self._dpos, self._dact, self._drem,
                self._kc, self._vc)
+        if self._ks is not None:
+            old = old + (self._ks, self._vs)
         # span measures the ENQUEUE cost only (the dispatch is async);
         # the device-side block time shows up as serving.drain on the
         # block that finally syncs it — together they are the
@@ -1009,7 +1271,14 @@ class ContinuousBatchingEngine:
         rids = [s.rid for s in self._slots if s is not None]
         with tracing.span("serving.dispatch", horizon=self.horizon,
                           rids=rids):
-            if self._paged:
+            if self._paged and self._ks is not None:
+                (toks, self._dtok, self._dpos, self._dact, self._drem,
+                 self._kc, self._vc, self._ks, self._vs) = self._decode(
+                    self.params, old[0], old[1], old[2], old[3],
+                    self._deos, table, old[4], old[5], old[6], old[7],
+                    self._next_key(), self._temp(),
+                )
+            elif self._paged:
                 (toks, self._dtok, self._dpos, self._dact, self._drem,
                  self._kc, self._vc) = self._decode(
                     self.params, old[0], old[1], old[2], old[3],
@@ -1080,10 +1349,23 @@ class ContinuousBatchingEngine:
             table = jnp.asarray(tbl)
         old = (self._dtok, self._dpos, self._dact, self._drem,
                self._kc, self._vc)
+        if self._ks is not None:
+            old = old + (self._ks, self._vs)
         rids = [s.rid for s in self._slots if s is not None]
         with tracing.span("serving.dispatch", horizon=self.horizon,
                           rids=rids, spec_k=d):
-            if self._paged:
+            if self._paged and self._ks is not None:
+                prog = _verify_program_paged_q(
+                    self.cfg, self.max_slots, self.pool_blocks,
+                    self._m, self.block_size, d, self.kv_quant,
+                )
+                (toks, self._dtok, self._dpos, self._dact, self._drem,
+                 self._kc, self._vc, self._ks, self._vs) = prog(
+                    self.params, old[0], jnp.asarray(dm), old[1],
+                    old[2], old[3], self._deos, table, old[4], old[5],
+                    old[6], old[7],
+                )
+            elif self._paged:
                 prog = _verify_program_paged(
                     self.cfg, self.max_slots, self.pool_blocks,
                     self._m, self.block_size, d,
@@ -1192,6 +1474,8 @@ class ContinuousBatchingEngine:
                 self._finish(i, outcome)
         if drafted is not None:
             self.metrics.on_spec(spec_drafted, spec_accepted)
+            if self._kvq_guard is not None:
+                self._kvq_guard.observe(spec_drafted, spec_accepted)
         return emitted
 
     def _drain_all(self) -> int:
@@ -1507,15 +1791,28 @@ class ContinuousBatchingEngine:
         c = self.prefill_chunk
         toks = np.asarray(seq[start:start + c], np.int32)[None, :]
         t_pf = self.clock()
-        prog = _prefill_chunk_program(self.cfg, c, self.block_size)
         table = jnp.asarray(np.asarray(self._tables[slot], np.int32))
-        old = (self._kc, self._vc)
+        quant = self._ks is not None
+        if quant:
+            prog = _prefill_chunk_program_q(
+                self.cfg, c, self.block_size, self.kv_quant
+            )
+            old = (self._kc, self._vc, self._ks, self._vs)
+        else:
+            prog = _prefill_chunk_program(self.cfg, c, self.block_size)
+            old = (self._kc, self._vc)
         with tracing.span("serving.prefill", bucket=c, rid=rid,
                           chunk=True):
-            self._kc, self._vc = prog(
-                self.params, jnp.asarray(toks), jnp.int32(start),
-                old[0], old[1], table,
-            )
+            if quant:
+                self._kc, self._vc, self._ks, self._vs = prog(
+                    self.params, jnp.asarray(toks), jnp.int32(start),
+                    old[0], old[1], old[2], old[3], table,
+                )
+            else:
+                self._kc, self._vc = prog(
+                    self.params, jnp.asarray(toks), jnp.int32(start),
+                    old[0], old[1], table,
+                )
             self.metrics.on_dispatch("prefill")
             # edl: no-lint[donation-safety] deliberate is_deleted() probe of the donation contract
             self._assert_donated(*old)
@@ -1544,31 +1841,56 @@ class ContinuousBatchingEngine:
         toks = np.zeros((1, tb), np.int32)
         toks[0, :n] = seq[start:]
         t_pf = self.clock()
-        prefill = _prefill_paged_program(
-            self.cfg, tb, self.block_size, self._sampling
-        )
         table = jnp.asarray(np.asarray(self._tables[slot], np.int32))
+        quant = self._ks is not None
         old = (self._dtok, self._dpos, self._dact, self._drem,
                self._deos, self._kc, self._vc)
+        if quant:
+            old = old + (self._ks, self._vs)
         rid_root = (
             disttrace.root("rid", rid) if rid is not None
             else contextlib.nullcontext()
         )
         with rid_root, tracing.span("serving.prefill", bucket=tb, rid=rid):
-            (tok0, self._dtok, self._dpos, self._dact, self._drem,
-             self._deos, self._kc, self._vc) = prefill(
-                self.params,
-                jnp.asarray(toks),
-                jnp.int32(start),
-                jnp.int32(n - 1),
-                jnp.int32(slot),
-                jnp.int32(max_new),
-                jnp.int32(-1 if eos_id is None else eos_id),
-                old[0], old[1], old[2], old[3], old[4], old[5], old[6],
-                table,
-                self._next_key(),
-                self._temp(),
-            )
+            if quant:
+                prefill = _prefill_paged_program_q(
+                    self.cfg, tb, self.block_size, self._sampling,
+                    self.kv_quant,
+                )
+                (tok0, self._dtok, self._dpos, self._dact, self._drem,
+                 self._deos, self._kc, self._vc, self._ks,
+                 self._vs) = prefill(
+                    self.params,
+                    jnp.asarray(toks),
+                    jnp.int32(start),
+                    jnp.int32(n - 1),
+                    jnp.int32(slot),
+                    jnp.int32(max_new),
+                    jnp.int32(-1 if eos_id is None else eos_id),
+                    old[0], old[1], old[2], old[3], old[4], old[5],
+                    old[6], old[7], old[8],
+                    table,
+                    self._next_key(),
+                    self._temp(),
+                )
+            else:
+                prefill = _prefill_paged_program(
+                    self.cfg, tb, self.block_size, self._sampling
+                )
+                (tok0, self._dtok, self._dpos, self._dact, self._drem,
+                 self._deos, self._kc, self._vc) = prefill(
+                    self.params,
+                    jnp.asarray(toks),
+                    jnp.int32(start),
+                    jnp.int32(n - 1),
+                    jnp.int32(slot),
+                    jnp.int32(max_new),
+                    jnp.int32(-1 if eos_id is None else eos_id),
+                    old[0], old[1], old[2], old[3], old[4], old[5], old[6],
+                    table,
+                    self._next_key(),
+                    self._temp(),
+                )
             self.metrics.on_dispatch("prefill")
             # edl: no-lint[donation-safety] deliberate is_deleted() probe of the donation contract
             self._assert_donated(*old)
@@ -1721,10 +2043,18 @@ class ContinuousBatchingEngine:
         if self._balloc.refcount(bid) <= 1:
             return
         dst = self._pg_alloc_or_preempt(slot)
-        old = (self._kc, self._vc)
-        self._kc, self._vc = self._copyblk(
-            old[0], old[1], jnp.int32(bid), jnp.int32(dst)
-        )
+        if self._ks is not None:
+            # quantized CoW carries the block's scales with its values
+            old = (self._kc, self._vc, self._ks, self._vs)
+            self._kc, self._vc, self._ks, self._vs = self._copyblk(
+                old[0], old[1], old[2], old[3],
+                jnp.int32(bid), jnp.int32(dst),
+            )
+        else:
+            old = (self._kc, self._vc)
+            self._kc, self._vc = self._copyblk(
+                old[0], old[1], jnp.int32(bid), jnp.int32(dst)
+            )
         # edl: no-lint[donation-safety] deliberate is_deleted() probe of the donation contract
         self._assert_donated(*old)
         tbl[j] = dst

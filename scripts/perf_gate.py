@@ -108,6 +108,19 @@ METRICS: Dict[str, MetricSpec] = {
     "serving_spec_b1_tokens_per_sec": MetricSpec(
         +1, 0.15, "serving_spec_config"
     ),
+    # quantized paged-KV rungs: concurrency at a fixed pool byte
+    # budget and analytic decode-step bytes moved are deterministic
+    # count/arithmetic ratios (tight); the b=1 wall clock rides the
+    # usual serving timing noise. All keyed on kv_quant_config.
+    "serving_kvq_concurrency_at_fixed_hbm": MetricSpec(
+        +1, 0.10, "kv_quant_config"
+    ),
+    "decode_kvq8_bytes_moved_ratio": MetricSpec(
+        +1, 0.05, "kv_quant_config"
+    ),
+    "decode_kvq8_b1_tokens_per_sec": MetricSpec(
+        +1, 0.15, "kv_quant_config"
+    ),
     # chip-lease elasticity rungs (scripts/exp_elasticity.py via the
     # bench's _elasticity_bench): the handover-window stall is a tiny
     # in-place reshard (sub-second host timing -> wide tolerance); the

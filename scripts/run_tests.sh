@@ -353,6 +353,53 @@ fi
 rm -rf "$ELDIR"
 t13=$(date +%s)
 echo "== phase 13 done in $((t13 - t12))s (rc=$rc13) =="
-echo "== total $((t13 - t0))s =="
 
-[ "$rc0" -eq 0 ] && [ "$rc1" -eq 0 ] && [ "$rc2" -eq 0 ] && [ "$rc3" -eq 0 ] && [ "$rc4" -eq 0 ] && [ "$rc5" -eq 0 ] && [ "$rc6" -eq 0 ] && [ "$rc7" -eq 0 ] && [ "$rc8" -eq 0 ] && [ "$rc9" -eq 0 ] && [ "$rc10" -eq 0 ] && [ "$rc11" -eq 0 ] && [ "$rc12" -eq 0 ] && [ "$rc13" -eq 0 ]
+echo "== phase 14: quantized-KV gate (loadgen int8 vs bf16-KV + edl check) =="
+# the --kv-quant int8 lane's CI contracts, on CPU:
+#   (a) the SAME seeded repetitive loadgen dryrun through the int8-KV
+#       and float-KV paged engines emits the IDENTICAL token total —
+#       quantization moves logit values, never termination/budget
+#       accounting;
+#   (b) the speculative acceptance rate — the live quality signal
+#       SpecAcceptGuard alarms on (tol 0.05 on the EMA in production)
+#       — stays healthy (> 15%) and within 10 points of the float-KV
+#       run. Tolerance calibrated for the tiny f32 CI model, whose
+#       near-uniform logits flip argmax on quantization far more than
+#       a trained checkpoint; the engine-level guard test
+#       (tests/test_kv_quant.py) pins the 5-point production gate;
+#   (c) `edl check` stays clean over the quantized programs (donation
+#       safety on the scale planes, telemetry conventions on the new
+#       gauges) — phase 0 covers this repo-wide; re-asserted here so
+#       a kvq regression names this phase.
+KVQDIR="${TMPDIR:-/tmp}/edl-kvq.$$"
+rm -rf "$KVQDIR"; mkdir -p "$KVQDIR"
+rc14=0
+JAX_PLATFORMS=cpu python -m edl_tpu.cli loadgen --dryrun --seed 3 \
+    --requests 16 --repetition 0.8 --repetition-len 3 --spec-k 4 \
+    --block-size 8 --json > "$KVQDIR/f.json" || rc14=1
+JAX_PLATFORMS=cpu python -m edl_tpu.cli loadgen --dryrun --seed 3 \
+    --requests 16 --repetition 0.8 --repetition-len 3 --spec-k 4 \
+    --block-size 8 --kv-quant int8 --json > "$KVQDIR/q.json" || rc14=1
+python - "$KVQDIR/f.json" "$KVQDIR/q.json" <<'PY' || rc14=1
+import json, sys
+f = json.load(open(sys.argv[1]))
+q = json.load(open(sys.argv[2]))
+assert q["workload"]["kv_quant"] == "int8", q["workload"]
+assert f["workload"]["kv_quant"] == "off", f["workload"]
+assert q["tokens_out"] == f["tokens_out"], \
+    f"int8-KV token total moved: {q['tokens_out']} vs {f['tokens_out']}"
+af, aq = f["spec"]["acceptance_rate"], q["spec"]["acceptance_rate"]
+assert aq > 0.15, f"int8-KV spec acceptance unhealthy: {aq:.1%}"
+assert abs(aq - af) <= 0.10, \
+    f"int8-KV acceptance drifted: {aq:.1%} vs float {af:.1%}"
+print(f"kvq loadgen OK: tokens={q['tokens_out']:.0f} identical, "
+      f"accept int8={aq:.1%} vs float={af:.1%}")
+PY
+python -m edl_tpu.cli check --baseline analysis_baseline.json \
+    > /dev/null || { echo "edl check FAILED under kvq"; rc14=1; }
+rm -rf "$KVQDIR"
+t14=$(date +%s)
+echo "== phase 14 done in $((t14 - t13))s (rc=$rc14) =="
+echo "== total $((t14 - t0))s =="
+
+[ "$rc0" -eq 0 ] && [ "$rc1" -eq 0 ] && [ "$rc2" -eq 0 ] && [ "$rc3" -eq 0 ] && [ "$rc4" -eq 0 ] && [ "$rc5" -eq 0 ] && [ "$rc6" -eq 0 ] && [ "$rc7" -eq 0 ] && [ "$rc8" -eq 0 ] && [ "$rc9" -eq 0 ] && [ "$rc10" -eq 0 ] && [ "$rc11" -eq 0 ] && [ "$rc12" -eq 0 ] && [ "$rc13" -eq 0 ] && [ "$rc14" -eq 0 ]

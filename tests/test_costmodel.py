@@ -188,6 +188,55 @@ def test_decode_block_flops_vs_xla_cost_analysis():
     assert 0.6 < ratio < 1.5, (analytic, flops, ratio)
 
 
+def test_int8_kv_decode_block_flops_and_bytes_vs_xla():
+    """The quantized-KV paged decode program prices like the float one
+    on FLOPs (dequant is a few multiplies against the matmul bill) —
+    pinned against XLA's own cost_analysis — while the analytic BYTE
+    ledger takes the KV dtype width + scale planes into account."""
+    cfg = llama.LlamaConfig.tiny(vocab=512)
+    B, H, bs, nb, M = 2, 1, 8, 9, 4  # S = M*bs = 32
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    kvh, hd, L = cfg.n_kv_heads, cfg.head_dim, cfg.n_layers
+    hdp = llama.kvq_packed_head_dim("int8", hd)
+
+    def block(p, tok, pos, table, kc, vc, ks, vs):
+        return llama.decode_step_slots_paged(
+            p, tok, pos, table, kc, vc, cfg, bs,
+            kv_quant="int8", ks=ks, vs=vs,
+        )
+
+    args = (
+        params,
+        jnp.zeros(B, jnp.int32),
+        jnp.zeros(B, jnp.int32),
+        jnp.zeros((B, M), jnp.int32),
+        jnp.zeros((L, nb, bs, kvh, hdp), jnp.int8),
+        jnp.zeros((L, nb, bs, kvh, hdp), jnp.int8),
+        jnp.zeros((L, nb, kvh), jnp.float32),
+        jnp.zeros((L, nb, kvh), jnp.float32),
+    )
+    S = M * bs
+    model = cm.CostModel(
+        cfg, peak=cm.peak_for_kind("v5e"),
+        kv_bytes_per_el=1.0, kv_block_size=bs,
+    )
+    flops = _xla_flops(jax.jit(block).lower(*args))
+    if flops is None:
+        pytest.skip("cost_analysis unavailable on this jax build")
+    ratio = model.decode_block(B, H, S).flops / flops
+    assert 0.6 < ratio < 1.5, (model.decode_block(B, H, S).flops, flops)
+    # the byte ledger: int8 KV reads half the float figure + scales
+    b_int8 = model.decode_block(B, H, S).hbm_bytes
+    b_f = cm.CostModel(cfg, peak=cm.peak_for_kind("v5e")).decode_block(
+        B, H, S
+    ).hbm_bytes
+    assert b_int8 < b_f
+    assert b_int8 == H * cm.decode_step_bytes(
+        cfg, model.param_bytes, B, S,
+        kv_bytes_per_el=1.0, kv_block_size=bs,
+    )
+
+
 # ---------------------------------------------------------------------------
 # EfficiencyMeter
 
