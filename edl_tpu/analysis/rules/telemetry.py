@@ -33,7 +33,17 @@ never guessed):
   ``obs/disttrace.py`` helpers — a hand-rolled ``d["trace_id"]``,
   ``.get("span_id")`` or ``{"parent_id": …}`` literal anywhere else
   forks the wire format the fleet merge and flow-link matcher depend
-  on (inject/extract/ids_of are the sanctioned accessors).
+  on (inject/extract/ids_of are the sanctioned accessors);
+* every ``series`` in a module-level ``DEFAULT_RULES`` literal (the
+  built-in alert rules, obs/alerts.py) names a metric some literal
+  registration call actually creates — a rule watching a typo'd or
+  deleted series silently never fires, which is the worst failure
+  mode a watchdog can have;
+* emitted event kinds in the ``alert.`` namespace are exactly
+  ``alert.fire`` / ``alert.resolve`` — `edl postmortem
+  --assert-recovered --sites alert.` chains on that pair, and a
+  third spelling (``alert.fired``…) would silently fall out of every
+  incident chain.
 """
 
 from __future__ import annotations
@@ -55,6 +65,9 @@ _EMIT_RECEIVERS = {"events", "flight", "recorder", "rec", "self"}
 _TRACE_KEYS = {"trace_id", "span_id", "parent_id"}
 _TRACE_HOME = "obs/disttrace.py"
 _DICT_METHODS = {"get", "pop", "setdefault"}
+# the flight-recorder kinds the alert engine may emit — postmortem's
+# alert_chains pairs exactly these (obs/postmortem.py)
+_ALERT_KINDS = {"alert.fire", "alert.resolve"}
 
 
 def _const_str(node: ast.AST) -> Optional[str]:
@@ -123,6 +136,8 @@ class TelemetryConventionsRule(Rule):
     def __init__(self):
         self._regs: List[_Registration] = []
         self._fault_sites: List[Tuple[str, str, int]] = []  # (site, path, line)
+        # (series, rule_name, path, line) from DEFAULT_RULES literals
+        self._alert_series: List[Tuple[str, str, str, int]] = []
 
     def _trace_key_finding(self, ctx, node, key, how) -> Finding:
         return Finding(
@@ -173,6 +188,30 @@ class TelemetryConventionsRule(Rule):
                             ctx, node, key, f".{node.func.attr}()"
                         )
                     )
+            if (
+                isinstance(node, ast.Assign)
+                and any(
+                    isinstance(t, ast.Name) and t.id == "DEFAULT_RULES"
+                    for t in node.targets
+                )
+            ):
+                # the built-in alert rules ship as a pure literal
+                # precisely so this check can read them statically
+                try:
+                    doc = ast.literal_eval(node.value)
+                except (ValueError, SyntaxError):
+                    doc = None
+                if isinstance(doc, dict):
+                    for rule in doc.get("rules", ()):
+                        if isinstance(rule, dict) and isinstance(
+                            rule.get("series"), str
+                        ):
+                            self._alert_series.append((
+                                rule["series"],
+                                str(rule.get("name", "?")),
+                                ctx.relpath,
+                                node.lineno,
+                            ))
             if not isinstance(node, ast.Call):
                 continue
             func = node.func
@@ -254,6 +293,27 @@ class TelemetryConventionsRule(Rule):
                                 ),
                             )
                         )
+                    elif (
+                        kind is not None
+                        and kind.startswith("alert.")
+                        and kind not in _ALERT_KINDS
+                    ):
+                        findings.append(
+                            Finding(
+                                rule=self.id,
+                                path=ctx.relpath,
+                                line=node.lineno,
+                                col=node.col_offset,
+                                message=(
+                                    f"event kind '{kind}' squats the "
+                                    "alert.* namespace — the postmortem "
+                                    "incident chainer pairs exactly "
+                                    "'alert.fire'/'alert.resolve', so any "
+                                    "other spelling falls out of every "
+                                    "chain"
+                                ),
+                            )
+                        )
 
             elif leaf == "fault_point" and node.args:
                 site = _const_str(node.args[0])
@@ -323,9 +383,32 @@ class TelemetryConventionsRule(Rule):
                     )
                 )
 
+        # built-in alert rules must watch series that exist: a rule
+        # over an unregistered name silently never fires
+        registered = {r.name for r in self._regs}
+        if registered:  # partial runs (no obs/ modules) can't judge
+            for series, rname, path, line in self._alert_series:
+                if series not in registered:
+                    findings.append(
+                        Finding(
+                            rule=self.id,
+                            path=path,
+                            line=line,
+                            col=0,
+                            message=(
+                                f"alert rule '{rname}' watches series "
+                                f"'{series}' which no literal "
+                                "counter/gauge/histogram registration "
+                                "creates — the rule can never fire"
+                            ),
+                            severity="error",
+                        )
+                    )
+
         # reset per-run state (rule instances are module singletons)
         self._regs = []
         self._fault_sites = []
+        self._alert_series = []
         return findings
 
 

@@ -366,10 +366,32 @@ def run_monitor(args) -> int:
     from edl_tpu.monitor.collector import Collector, StoreSource
 
     store = JobStore(args.store)
+    alerts_source = None
+    if getattr(args, "tsdb", None):
+        # the monitoring JSONL carries alert state inline: each poll
+        # evaluates the rules over the history dir, no second endpoint
+        from edl_tpu.obs import alerts as obs_alerts
+        from edl_tpu.obs.tsdb import TSDB
+
+        try:
+            engine = obs_alerts.engine_from_doc(
+                obs_alerts.load_rules_doc(args.rules),
+                time_scale=args.time_scale,
+            )
+        except (OSError, ValueError) as e:
+            print(f"bad rules: {e}", file=sys.stderr)
+            return 2
+        db = TSDB(args.tsdb)
+
+        def alerts_source() -> dict:
+            engine.evaluate(db, time.time())
+            return engine.to_block()
+
     Collector(
         StoreSource(store),
         interval_s=args.interval,
         jsonl=getattr(args, "json", False),
+        alerts_source=alerts_source,
     ).run(n_polls=args.polls)
     return 0
 
@@ -392,6 +414,116 @@ def run_top(args) -> int:
         if args.polls is not None and i >= args.polls:
             return 0
         time.sleep(args.interval)
+
+
+def _watch_line(tr: dict) -> str:
+    detail = " ".join(
+        f"{k}={v:.6g}"
+        for k, v in sorted(tr.items())
+        if k not in ("transition", "rule", "severity", "t")
+        and isinstance(v, (int, float))
+    )
+    return (f"[{tr['t']:.3f}] {tr['transition'].upper():7s} "
+            f"{tr['rule']} severity={tr['severity']} {detail}").rstrip()
+
+
+def run_watch(args) -> int:
+    """Evaluate alert rules over metric history: tail a live exporter
+    (scrape /metrics on a cadence, record into a local tsdb, evaluate)
+    or replay a recorded tsdb directory (deterministic — the CI alert
+    lane). Rules come from --rules JSON or the shipped defaults
+    (obs/alerts.py DEFAULT_RULES); --time-scale shrinks every window
+    so production burn-rate rules run against seconds-long CI replays.
+    Alert transitions print as they happen (and emit alert.fire/
+    alert.resolve flight-recorder events for `edl postmortem --sites
+    alert.`); the exit code is the number of PAGES still active at
+    exit, so a CI step fails iff something is burning."""
+    from edl_tpu import obs
+    from edl_tpu.obs import alerts as obs_alerts
+    from edl_tpu.obs import events as obs_events
+    from edl_tpu.obs.tsdb import TSDB, snapshot_from_prometheus_text
+
+    try:
+        doc = obs_alerts.load_rules_doc(args.rules)
+        engine = obs_alerts.engine_from_doc(
+            doc, time_scale=args.time_scale,
+            registry=obs.default_registry(),
+        )
+    except (OSError, ValueError) as e:
+        print(f"bad rules: {e}", file=sys.stderr)
+        return 2
+
+    src = args.source
+    transitions: list = []
+
+    def _saw(trs) -> None:
+        for tr in trs:
+            transitions.append(tr)
+            if not args.json:
+                print(_watch_line(tr), flush=True)
+
+    if os.path.isdir(src):
+        db = TSDB(src)
+        seen_t: Optional[float] = None
+
+        def pass_once() -> None:
+            nonlocal seen_t
+            new = [t for t in db.raw_times()
+                   if seen_t is None or t > seen_t]
+            for t in new:
+                _saw(engine.evaluate(db, t))
+            if new:
+                seen_t = new[-1]
+    else:
+        import tempfile
+
+        url = src if src.startswith("http") else f"http://{src}"
+        db = TSDB(args.record or tempfile.mkdtemp(prefix="edl-watch-"))
+
+        def pass_once() -> None:
+            text = obs.scrape(url)
+            now = time.time()
+            db.append(snapshot_from_prometheus_text(text), t=now)
+            _saw(engine.evaluate(db, now))
+
+    polls = 1 if args.once else args.polls
+    i = 0
+    while True:
+        try:
+            pass_once()
+        except OSError as e:
+            print(f"scrape failed for {src}: {e}", file=sys.stderr)
+            return 2
+        i += 1
+        if polls is not None and i >= polls:
+            break
+        time.sleep(args.interval)
+
+    if args.events_out:
+        recs = obs_events.default_recorder().records()
+        with open(args.events_out, "w") as f:
+            for r in recs:
+                f.write(json.dumps(r, default=str,
+                                   separators=(",", ":")) + "\n")
+        print(f"# events -> {args.events_out} ({len(recs)} events)",
+              file=sys.stderr)
+
+    summary = {
+        "rules": sorted(r.name for r in engine.rules),
+        "time_scale": engine.time_scale,
+        "transitions": transitions,
+        "active": engine.active(),
+        "pages": engine.pages(),
+        "fired_total": engine.to_block()["fired_total"],
+    }
+    if args.json:
+        print(json.dumps(summary, sort_keys=True))
+    else:
+        act = (", ".join(f"{a['rule']}({a['severity']})"
+                         for a in summary["active"]) or "none")
+        print(f"WATCH {len(summary['rules'])} rules  "
+              f"fired={summary['fired_total']}  active: {act}")
+    return min(engine.pages(), 100)
 
 
 def run_postmortem(args) -> int:
@@ -1284,12 +1416,19 @@ def run_loadgen(args) -> int:
     from edl_tpu.serving.metrics import ServingMetrics
     from edl_tpu.serving.scheduler import AdmissionError
 
+    tsdb_db = None
+    if getattr(args, "tsdb_dir", None):
+        from edl_tpu.obs.tsdb import TSDB
+
+        tsdb_db = TSDB(args.tsdb_dir)
+        print(f"# metric history -> {args.tsdb_dir}", file=sys.stderr)
     exporter = None
     if args.metrics_port is not None:
         from edl_tpu import obs
 
         obs.bridge_tracer()
-        exporter = obs.start_exporter(port=args.metrics_port)
+        exporter = obs.start_exporter(port=args.metrics_port,
+                                      history=tsdb_db)
         print(f"# metrics endpoint {exporter.url}/metrics", file=sys.stderr)
 
     if not args.no_warmup:
@@ -1328,16 +1467,30 @@ def run_loadgen(args) -> int:
 
     def refresh_gauges():
         # live burn-rate view: the exporter's SLO gauges track the
-        # run as it happens, not just the final report
-        slo.update_gauges(
-            slo.compute_goodput(
-                slo.request_records(metrics), cmap, time.monotonic() - t0
-            )
-        )
+        # run as it happens, not just the final report. --slo-window
+        # scopes attainment to requests that finished in the trailing
+        # window, so the gauges RECOVER once a latency incident ends
+        # (cumulative attainment never forgets — useless for alert
+        # resolve). Nothing is published/recorded before the first
+        # finished request: "no traffic yet" must read as no data,
+        # not as 0% attainment (which would page).
+        now_m = time.monotonic()
+        since = now_m - args.slo_window if args.slo_window > 0 else None
+        recs = slo.request_records(metrics, since_s=since)
+        if not recs:
+            return
+        wall = min(args.slo_window, now_m - t0) if since else now_m - t0
+        slo.update_gauges(slo.compute_goodput(recs, cmap, wall))
+        if tsdb_db is not None:
+            from edl_tpu.obs.metrics import default_registry
+
+            tsdb_db.append(default_registry().snapshot())
 
     res = loadgen.replay(
         engine, reqs, speed=args.speed,
-        on_tick=refresh_gauges if exporter is not None else None,
+        on_tick=(refresh_gauges
+                 if (exporter is not None or tsdb_db is not None)
+                 else None),
     )
     report = slo.compute_goodput(
         slo.request_records(metrics), cmap, res["wall_s"]
@@ -1368,6 +1521,8 @@ def run_loadgen(args) -> int:
             ),
         }
     slo.update_gauges(report)
+    if tsdb_db is not None:
+        tsdb_db.flush()  # close open downsample buckets for readers
     if args.dryrun and exporter is not None:
         try:
             _check_loadgen_scrape(exporter)
@@ -1937,6 +2092,21 @@ def build_parser() -> argparse.ArgumentParser:
         "text table — the machine-readable twin scripts and the "
         "autoscaler can tail",
     )
+    m.add_argument(
+        "--tsdb", default=None,
+        help="metric-history directory to evaluate alert rules over "
+        "each poll; every sample then carries an `alerts` block "
+        "(active alerts + last transition)",
+    )
+    m.add_argument(
+        "--rules", default=None,
+        help="alert rules JSON for --tsdb (default: the shipped "
+        "rules, obs/alerts.py)",
+    )
+    m.add_argument(
+        "--time-scale", type=float, default=None,
+        help="window scale for --rules (see `edl watch`)",
+    )
     m.set_defaults(fn=run_monitor)
 
     tp = sub.add_parser(
@@ -1955,6 +2125,55 @@ def build_parser() -> argparse.ArgumentParser:
     tp.add_argument("--polls", type=int, default=None, help="stop after N polls")
     tp.add_argument("--timeout", type=float, default=5.0)
     tp.set_defaults(fn=run_top)
+
+    w = sub.add_parser(
+        "watch",
+        help="alerting watchdog: evaluate threshold / burn-rate / "
+        "anomaly rules over metric history (tail a live exporter or "
+        "replay a recorded tsdb dir); exit code = active pages",
+    )
+    w.add_argument(
+        "source",
+        help="host:port or URL of an exporter (tailed: each poll "
+        "scrapes /metrics and records it), or a tsdb history "
+        "directory (replayed deterministically)",
+    )
+    w.add_argument(
+        "--rules", default=None,
+        help="rules JSON (doc/observability.md grammar); default: the "
+        "shipped burn-rate + watchdog rules (obs/alerts.py)",
+    )
+    w.add_argument(
+        "--time-scale", type=float, default=None,
+        help="multiply every rule window (e.g. 0.01 turns the 5m/1h "
+        "fast-burn pair into 3s/36s for a CI replay); default: the "
+        "rules doc's own time_scale",
+    )
+    w.add_argument("--interval", type=float, default=2.0)
+    w.add_argument("--polls", type=int, default=None,
+                   help="stop after N polls (default: forever)")
+    w.add_argument(
+        "--once", action="store_true",
+        help="single pass: one scrape, or one full replay of a "
+        "recorded dir, then exit",
+    )
+    w.add_argument(
+        "--json", action="store_true",
+        help="suppress per-transition lines; print one JSON summary "
+        "(rules, transitions, active, pages) at exit",
+    )
+    w.add_argument(
+        "--record", default=None,
+        help="when tailing a live endpoint, record scrapes into this "
+        "tsdb dir (default: a temp dir)",
+    )
+    w.add_argument(
+        "--events-out", default=None,
+        help="write the watcher's flight-recorder JSONL (the "
+        "alert.fire/alert.resolve timeline) here for "
+        "`edl postmortem --sites alert.`",
+    )
+    w.set_defaults(fn=run_watch)
 
     v = sub.add_parser("validate", help="parse + validate a manifest")
     v.add_argument("manifest")
@@ -2449,6 +2668,21 @@ def build_parser() -> argparse.ArgumentParser:
         help="expose /metrics during the run with LIVE SLO burn "
         "gauges (edl_slo_ttft_ok_ratio{slo_class}) refreshed every "
         "few engine steps (0 = ephemeral)",
+    )
+    lg.add_argument(
+        "--slo-window", type=float, default=0.0,
+        help="compute the live SLO burn gauges over requests that "
+        "finished within this trailing window (seconds) instead of "
+        "cumulatively; 0 = whole-run attainment. Windowed gauges "
+        "recover after an incident clears — which is what burn-rate "
+        "alert *resolve* needs",
+    )
+    lg.add_argument(
+        "--tsdb-dir", default=None,
+        help="record registry snapshots into this metric-history "
+        "directory on the gauge-refresh cadence (obs/tsdb.py); "
+        "served on /history when --metrics-port is set and "
+        "replayable offline with `edl watch DIR`",
     )
     lg.add_argument("--mesh", default="", help="as in `edl serve`")
     lg.add_argument("--int8", action="store_true", help="as in `edl serve`")

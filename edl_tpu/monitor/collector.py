@@ -55,6 +55,11 @@ class MonitorSample:
     # kv_occupancy_ratio) — empty until a process publishes them. Rides
     # to_record(), so `edl monitor --json` consumers see the roofline.
     efficiency: Dict[str, float] = field(default_factory=dict)
+    # alert-engine state (obs/alerts.py AlertEngine.to_block():
+    # active/fired_total/last_transition) — populated when the monitor
+    # was given an evaluation source (`edl monitor --tsdb`). Rides
+    # to_record(), so `edl monitor --json` consumers see active pages.
+    alerts: Dict[str, object] = field(default_factory=dict)
 
     @property
     def cpu_util(self) -> float:
@@ -79,6 +84,7 @@ class MonitorSample:
             return "\n".join(
                 self._serving_lines()
                 + (self._efficiency_lines() if self.efficiency else [])
+                + self._alert_lines()
             )
         lines = [
             f"SUBMITTED-JOBS: {len(self.submitted_jobs)}",
@@ -113,7 +119,27 @@ class MonitorSample:
             lines.extend(self._serving_lines())
         if self.efficiency:
             lines.extend(self._efficiency_lines())
+        lines.extend(self._alert_lines())
         return "\n".join(lines)
+
+    def _alert_lines(self) -> List[str]:
+        """ALERTS strip — only when the engine reports firing rules, in
+        the `edl top` INCIDENT-strip style (quiet fleets stay quiet)."""
+        active = (self.alerts or {}).get("active") or []
+        if not active:
+            return []
+        parts = []
+        for a in active:
+            detail = " ".join(
+                f"{k}={v:.4g}" for k, v in sorted(a.items())
+                if k not in ("rule", "severity", "since")
+                and isinstance(v, (int, float))
+            )
+            parts.append(
+                f"{a.get('rule')}[{a.get('severity')}]"
+                + (f" {detail}" if detail else "")
+            )
+        return ["ALERTS: " + "  ".join(parts)]
 
     def _efficiency_lines(self) -> List[str]:
         e = self.efficiency
@@ -285,16 +311,23 @@ class Collector:
     (:meth:`MonitorSample.to_record`) — the machine-readable twin."""
 
     def __init__(
-        self, source, interval_s: float = 10.0, out=None, jsonl: bool = False
+        self, source, interval_s: float = 10.0, out=None, jsonl: bool = False,
+        alerts_source=None,
     ):
         self.source = source
         self.interval_s = interval_s
         self.out = out
         self.jsonl = jsonl
+        # zero-arg callable returning an AlertEngine.to_block() dict
+        # (obs/alerts.py) — evaluated once per poll so the alerts block
+        # is as fresh as the census it rides with
+        self.alerts_source = alerts_source
         self.samples: List[MonitorSample] = []
 
     def poll(self) -> MonitorSample:
         s = self.source.sample()
+        if self.alerts_source is not None:
+            s.alerts = self.alerts_source()
         self.samples.append(s)
         return s
 

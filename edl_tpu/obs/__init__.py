@@ -81,3 +81,16 @@ from edl_tpu.obs.memledger import (  # noqa: F401
     default_ledger,
     tree_nbytes,
 )
+from edl_tpu.obs import tsdb  # noqa: F401  (on-disk metric history)
+from edl_tpu.obs.tsdb import (  # noqa: F401
+    TSDB,
+    series_key,
+    snapshot_from_prometheus_text,
+)
+from edl_tpu.obs import alerts  # noqa: F401  (burn-rate/anomaly alerting)
+from edl_tpu.obs.alerts import (  # noqa: F401
+    DEFAULT_RULES,
+    AlertEngine,
+    engine_from_doc,
+    load_rules_doc,
+)

@@ -16,6 +16,11 @@
   by ``?rid=``, ``?kind=``, ``?severity=`` and bounded by ``?n=``
   (obs/events.py; the coordinator serves the worker-labeled fleet
   union here via its events source);
+* ``/history``  — windowed queries over the process's on-disk metric
+  history (obs/tsdb.py) when a ``history`` store is attached:
+  ``?name=&t0=&t1=&step=`` plus any label matchers; no ``name`` lists
+  the recorded series (doc/observability.md "History, alerting & burn
+  rates");
 * ``/healthz``  — liveness JSON (status, uptime, pid).
 
 Pull-based on purpose (the Prometheus model): the process never blocks
@@ -57,6 +62,9 @@ class MetricsExporter:
     ``trace_source`` is a zero-arg callable returning a chrome-trace
     document for ``/trace`` — defaults to the local tracer+recorder
     merge; the coordinator passes the fleet trace merge.
+    ``history`` is a :class:`~edl_tpu.obs.tsdb.TSDB` (or a string
+    path to a history directory) served on ``/history``; absent, the
+    endpoint 404s and is omitted from ``/healthz``.
     """
 
     def __init__(
@@ -68,7 +76,13 @@ class MetricsExporter:
         tracer=None,
         events_source: Optional[Callable[[], List[dict]]] = None,
         trace_source: Optional[Callable[[], dict]] = None,
+        history=None,
     ):
+        if isinstance(history, str):
+            from edl_tpu.obs.tsdb import TSDB
+
+            history = TSDB(history)
+        self.history = history
         if source is None:
             from edl_tpu.obs.metrics import default_registry
 
@@ -130,7 +144,21 @@ class MetricsExporter:
                             parse_qs(parts.query)
                         ).encode()
                         ctype = "application/x-ndjson"
+                    elif path == "/history":
+                        if exporter.history is None:
+                            self.send_error(
+                                404, "no history store attached"
+                            )
+                            return
+                        body = exporter.history.render_history(
+                            parse_qs(parts.query)
+                        ).encode()
+                        ctype = "application/json"
                     elif path in ("/", "/healthz"):
+                        endpoints = ["/metrics", "/trace", "/events"]
+                        if exporter.history is not None:
+                            endpoints.append("/history")
+                        endpoints.append("/healthz")
                         body = json.dumps(
                             {
                                 "status": "ok",
@@ -138,10 +166,7 @@ class MetricsExporter:
                                     time.monotonic() - exporter._t0, 3
                                 ),
                                 "pid": os.getpid(),
-                                "endpoints": [
-                                    "/metrics", "/trace", "/events",
-                                    "/healthz",
-                                ],
+                                "endpoints": endpoints,
                             }
                         ).encode()
                         ctype = "application/json"
@@ -247,12 +272,13 @@ class MetricsExporter:
 
 def start_exporter(
     source=None, *, port: int = 0, host: str = "127.0.0.1", tracer=None,
-    events_source=None, trace_source=None,
+    events_source=None, trace_source=None, history=None,
 ) -> MetricsExporter:
     """Convenience: construct + start (``port=0`` = ephemeral)."""
     return MetricsExporter(
         source, port=port, host=host, tracer=tracer,
         events_source=events_source, trace_source=trace_source,
+        history=history,
     ).start()
 
 

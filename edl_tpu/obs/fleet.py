@@ -83,6 +83,8 @@ class MetricsPusher:
         trace_window: int = 128,
         tracer=None,
         clock_refresh: Optional[Callable[[], None]] = None,
+        tsdb=None,
+        crosscheck: Optional[Callable[[], Optional[dict]]] = None,
     ):
         self._publish = publish
         self.interval_s = max(float(interval_s), 0.1)
@@ -104,6 +106,21 @@ class MetricsPusher:
         # closure): offsets drift, so the estimate refreshes on the
         # push cadence without a dedicated thread
         self._clock_refresh = clock_refresh
+        # local metric history (obs/tsdb.py): the SAME snapshot the
+        # publish ships is appended on the same cadence — burn-rate
+        # windows and `edl watch` come for free, zero new RPCs. A
+        # string is taken as a directory path.
+        if isinstance(tsdb, str):
+            from edl_tpu.obs.tsdb import TSDB
+
+            tsdb = TSDB(tsdb)
+        self.tsdb = tsdb
+        # ledger-vs-live-arrays crosscheck on the append cadence
+        # (memledger satellite): default only when history is on —
+        # the result lands in the snapshot as an alertable series
+        if crosscheck is None and tsdb is not None:
+            crosscheck = _default_crosscheck
+        self._crosscheck = crosscheck
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # push_once runs on the pusher thread AND from stop()'s
@@ -124,7 +141,17 @@ class MetricsPusher:
             # chaos site: the paths a real outage exercises are the
             # registry serialize + the injected publish
             faults.fault_point("metrics.push")
-            self._publish(self.registry.snapshot_json())
+            if self._crosscheck is not None:
+                self._run_crosscheck()
+            snap = self.registry.snapshot_json()
+            self._publish(snap)
+            # push_once can run on the pusher thread AND from stop()'s
+            # last-gasp call; the store's open-bucket accumulators are
+            # not reentrant, so appends serialize on the same lock as
+            # the streak state
+            with self._state_lock:
+                if self.tsdb is not None:
+                    self.tsdb.append(snap)
             if self._events_publish is not None:
                 rec = self._recorder
                 if rec is None:
@@ -159,6 +186,23 @@ class MetricsPusher:
                 log.warn("metrics push failed (will retry)", error=str(e))
             return False
 
+    def _run_crosscheck(self) -> None:
+        """Refresh ``edl_hbm_crosscheck_drift_bytes`` from the memory
+        ledger on the push/append cadence, so ledger drift is a series
+        an alert rule can watch instead of a manual call."""
+        try:
+            res = self._crosscheck()
+        # edl: no-lint[silent-failure] the crosscheck needs live jax state; on a host without devices it degrades to "no reading", never to a failed push
+        except Exception:
+            res = None
+        if res is None:
+            return
+        self.registry.gauge(
+            "edl_hbm_crosscheck_drift_bytes",
+            "ledger-vs-live-arrays drift from memledger.crosscheck(), "
+            "refreshed on the metrics-push/tsdb-append cadence",
+        ).set(abs(float(res.get("unaccounted_bytes", 0.0))))
+
     def next_wait_s(self) -> float:
         """Delay before the next push attempt: the fixed interval while
         healthy; doubling from the interval per consecutive failure,
@@ -191,6 +235,18 @@ class MetricsPusher:
             self._thread = None
         if final_push:
             self.push_once()  # last-gasp snapshot so a clean exit is visible
+        with self._state_lock:
+            if self.tsdb is not None:
+                self.tsdb.flush()  # close open buckets for readers
+
+
+def _default_crosscheck() -> Optional[dict]:
+    """The pusher's default ledger probe: the process-wide ledger's
+    ``crosscheck()`` (obs/memledger.py), which itself returns None on
+    hosts without live jax state."""
+    from edl_tpu.obs import memledger
+
+    return memledger.default_ledger().crosscheck()
 
 
 def aggregate_snapshots(

@@ -572,6 +572,23 @@ def ensure_core_series(reg: Optional[MetricsRegistry] = None) -> MetricsRegistry
         "edl_events_dropped_total",
         "flight-recorder events evicted from the bounded ring",
     )
+    # history & alerting (obs/tsdb.py, obs/alerts.py —
+    # doc/observability.md "History, alerting & burn rates")
+    r.gauge(
+        "edl_alerts_active",
+        "alerts currently firing by severity (page/warn/info)",
+        ("severity",),
+    )
+    r.counter(
+        "edl_alerts_fired_total",
+        "alert fire transitions by rule name",
+        ("rule",),
+    )
+    r.gauge(
+        "edl_hbm_crosscheck_drift_bytes",
+        "ledger-vs-live-arrays drift from memledger.crosscheck(), "
+        "refreshed on the metrics-push/tsdb-append cadence",
+    )
     return r
 
 

@@ -33,6 +33,7 @@ __all__ = [
     "by_rid",
     "incidents",
     "fault_chains",
+    "alert_chains",
     "verify_recovered",
     "verify_no_incidents",
     "render_report",
@@ -227,19 +228,63 @@ def fault_chains(
     return chains
 
 
+def alert_chains(
+    events: List[Dict[str, Any]], site_prefix: str = "alert."
+) -> List[Dict[str, Any]]:
+    """The alert-lifecycle analog of :func:`fault_chains`: every
+    ``alert.fire`` whose site (``alert.<rule>``, obs/alerts.py)
+    matches the prefix must be followed by an ``alert.resolve`` for
+    the SAME site — a page that never resolved is an open incident,
+    and the CI fault lane asserts the injected breach both fired and
+    cleared."""
+    evs = _order(events)
+    chains: List[Dict[str, Any]] = []
+    for i, e in enumerate(evs):
+        if e.get("kind") != "alert.fire":
+            continue
+        site = str((e.get("corr") or {}).get("site", ""))
+        if not site.startswith(site_prefix):
+            continue
+        res = next(
+            (x for x in evs[i + 1:]
+             if x.get("kind") == "alert.resolve"
+             and str((x.get("corr") or {}).get("site", "")) == site),
+            None,
+        )
+        problems = [] if res is not None else [
+            f"alert {site} fired (seq {e.get('seq')}) but never resolved"
+        ]
+        chains.append({
+            "fire": e,
+            "site": site,
+            "resolve": res,
+            "problems": problems,
+            "ok": not problems,
+        })
+    return chains
+
+
 def verify_recovered(
     events: List[Dict[str, Any]], site_prefix: str = "serve."
 ) -> List[str]:
     """CI assertion: every injected fault at ``site_prefix*`` is
     followed by a recorded recovery whose affected requests were
-    re-prefilled and served. Returns problems (empty = pass). A dump
-    with NO matching faults is itself a problem — a chaos lane whose
-    faults never fired tested nothing."""
+    re-prefilled and served, and every fired alert at a matching site
+    resolved. Returns problems (empty = pass). A dump with NO matching
+    faults or alerts is itself a problem — a chaos lane whose faults
+    never fired tested nothing (``--sites alert.`` asserts the alert
+    lifecycle the same way)."""
     chains = fault_chains(events, site_prefix)
-    if not chains:
-        return [f"no injected faults at sites {site_prefix}* in this dump"]
+    achains = alert_chains(events, site_prefix)
+    if not chains and not achains:
+        return [
+            f"no injected faults or fired alerts at sites "
+            f"{site_prefix}* in this dump"
+        ]
     problems: List[str] = []
     for c in chains:
+        problems.extend(c["problems"])
+    for c in achains:
         problems.extend(c["problems"])
     return problems
 

@@ -87,7 +87,9 @@ def _get(rec: Any, name: str, default=0.0):
     return getattr(rec, name, default)
 
 
-def request_records(metrics: Any) -> List[Dict[str, Any]]:
+def request_records(
+    metrics: Any, since_s: Optional[float] = None
+) -> List[Dict[str, Any]]:
     """Flatten ``ServingMetrics.requests`` into plain per-request
     dicts with the phase decomposition precomputed:
 
@@ -96,9 +98,17 @@ def request_records(metrics: Any) -> List[Dict[str, Any]]:
     -> finish), ``ttft_s``, ``tpot_s`` (0.0 when < 2 tokens), plus
     ``tenant`` / ``slo_class`` / ``outcome`` / ``tokens``. The three
     phases sum to ``total_s`` exactly for any finished request — the
-    invariant tests/test_loadgen.py pins."""
+    invariant tests/test_loadgen.py pins.
+
+    ``since_s`` (same clock as the metrics object, ``time.monotonic``
+    by default) keeps only requests that FINISHED at/after that
+    instant — the trailing-window live view burn-rate gauges want,
+    where attainment reflects what the system is doing NOW instead of
+    averaging in the whole run's history."""
     out: List[Dict[str, Any]] = []
     for rid, rec in metrics.requests.items():
+        if since_s is not None and float(_get(rec, "finish_s")) < since_s:
+            continue
         has_submit = bool(_get(rec, "has_submit", False))
         has_pop = bool(_get(rec, "has_pop", False))
         submit = float(_get(rec, "submit_s"))

@@ -183,6 +183,27 @@ def summarize(fams: _Fams) -> List[str]:
             f"bytes={_total(fams, 'edl_checkpoint_bytes_total'):.0f}"
         )
 
+    # alerts strip (obs/alerts.py gauges, published by whichever
+    # process runs an AlertEngine — `edl watch`, the coordinator, a
+    # monitor) — shown only while something fires or has fired, same
+    # quiet-fleet policy as INCIDENT below
+    pages = _total(fams, "edl_alerts_active", severity="page")
+    warns = _total(fams, "edl_alerts_active", severity="warn")
+    fired = _total(fams, "edl_alerts_fired_total")
+    if pages or warns or fired:
+        by_rule = " ".join(
+            f"{labels.get('rule')}={v:.0f}"
+            for labels, v in sorted(
+                fams.get("edl_alerts_fired_total", ()),
+                key=lambda p: p[0].get("rule", ""),
+            )
+            if v
+        )
+        lines.append(
+            f"ALERTS   pages={pages:.0f} warns={warns:.0f} "
+            f"fired={fired:.0f}" + (f"  [{by_rule}]" if by_rule else "")
+        )
+
     # incident strip: fleet health (sourced from the flight-recorder
     # counters + the robustness series) without opening any dumps —
     # shown only when something is actually wrong/noteworthy

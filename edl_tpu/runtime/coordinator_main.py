@@ -51,6 +51,23 @@ def main(argv=None) -> int:
         help="job name for the metrics KV prefix ({job}/metrics/*); "
         "only used with --metrics-port",
     )
+    ap.add_argument(
+        "--tsdb-dir", default=None,
+        help="record the fleet-aggregated snapshot into this metric-"
+        "history directory (obs/tsdb.py) every --watch-interval and "
+        "evaluate the alert rules over it; served on /history and "
+        "replayable with `edl watch DIR`. Only with --metrics-port.",
+    )
+    ap.add_argument(
+        "--rules", default=None,
+        help="alert-rules JSON for the fleet watchdog (default: the "
+        "built-in obs/alerts.py DEFAULT_RULES); only with --tsdb-dir",
+    )
+    ap.add_argument(
+        "--watch-interval", type=float, default=10.0,
+        help="seconds between fleet snapshot/alert-evaluation passes "
+        "when --tsdb-dir is set",
+    )
     a = ap.parse_args(argv)
 
     from edl_tpu.runtime.coordinator import (
@@ -76,6 +93,17 @@ def main(argv=None) -> int:
 
     server = CoordinatorServer(port=a.port, member_ttl_s=a.member_ttl)
     client = server.client()
+
+    # optional fleet watchdog: append the aggregated snapshot to an
+    # on-disk history and run the alert rules over it — the coordinator
+    # is the one process that already sees every worker's series, so
+    # fleet-level burn rates evaluate here (doc/observability.md
+    # "History, alerting & burn rates")
+    db = engine = None
+    if a.tsdb_dir:
+        db = obs.TSDB(a.tsdb_dir)
+        engine = obs.engine_from_doc(obs.load_rules_doc(a.rules))
+
     exporter = obs.start_exporter(
         lambda: obs.collect_fleet(client, a.job, EXTRA_METRIC_SOURCES),
         port=a.metrics_port,
@@ -91,6 +119,7 @@ def main(argv=None) -> int:
         trace_source=lambda: obs.collect_fleet_trace(
             client, a.job, EXTRA_METRIC_SOURCES
         ),
+        history=db,
     )
     print(
         f"coordinator on :{a.port}; fleet metrics at {exporter.url}/metrics "
@@ -98,12 +127,31 @@ def main(argv=None) -> int:
         flush=True,
     )
     try:
+        next_watch = time.time()
         while server._proc.poll() is None:
             time.sleep(0.5)
+            if db is not None and time.time() >= next_watch:
+                next_watch = time.time() + a.watch_interval
+                try:
+                    reg = obs.collect_fleet(
+                        client, a.job, EXTRA_METRIC_SOURCES
+                    )
+                    now = time.time()
+                    db.append(reg.snapshot(), t=now)
+                    for tr in engine.evaluate(db, now):
+                        print(
+                            f"ALERT {tr['transition']} {tr['rule']} "
+                            f"[{tr['severity']}]",
+                            flush=True,
+                        )
+                except Exception:  # edl: no-lint[silent-failure] the watchdog must never take down the coordinator it watches; next pass retries
+                    pass
         return server._proc.returncode or 0
     except KeyboardInterrupt:
         return 0
     finally:
+        if db is not None:
+            db.flush()
         exporter.stop()
         server.stop()
 

@@ -114,6 +114,13 @@ class WorkerConfig:
     # /metrics (runtime/coordinator_main.py --metrics-port). 0 = no
     # pushes. Matches the reference collector's 10 s census period.
     metrics_push_s: float = 10.0
+    # EDL_TSDB_DIR: record this worker's registry snapshot into an
+    # on-disk metric history (obs/tsdb.py) on the push cadence — zero
+    # new RPCs, the pusher already holds the snapshot. Served on the
+    # exporter's /history and replayable with `edl watch DIR`. Setting
+    # it also arms the memledger crosscheck on the same cadence
+    # (edl_hbm_crosscheck_drift_bytes). "" = off.
+    tsdb_dir: str = ""
     # TPU slice this host belongs to (multi-slice topology). -1 =
     # unknown: the mesh build falls back to the hardware's own
     # ``device.slice_index`` (real multislice TPU exposes it). When set
@@ -170,6 +177,7 @@ class WorkerConfig:
             int8_wgrad_bf16=e.get("EDL_INT8_WGRAD_BF16", "0") == "1",
             metrics_port=int(e.get("EDL_METRICS_PORT", "-1")),
             metrics_push_s=float(e.get("EDL_METRICS_PUSH_S", "10")),
+            tsdb_dir=e.get("EDL_TSDB_DIR", ""),
             # MEGASCALE_SLICE_ID is what GKE injects into multislice
             # TPU pods — honoring it makes the kube path slice-aware
             # with no manifest change

@@ -272,9 +272,15 @@ class ElasticWorker:
                 log.warn("clock sync failed", error=str(e))
 
         _clock_publish()
+        # EDL_TSDB_DIR: one shared on-disk history (obs/tsdb.py) — the
+        # pusher appends snapshots into it on its cadence, the exporter
+        # serves it on /history, `edl watch DIR` replays it offline
+        tsdb = obs.TSDB(cfg.tsdb_dir) if cfg.tsdb_dir else None
         if cfg.metrics_port >= 0:
             try:
-                self._exporter = obs.start_exporter(port=cfg.metrics_port)
+                self._exporter = obs.start_exporter(
+                    port=cfg.metrics_port, history=tsdb
+                )
                 # advertise the bound (possibly ephemeral) port so
                 # `edl top` / scrapers can discover it through KV
                 self.client.kv_put(
@@ -304,6 +310,10 @@ class ElasticWorker:
                     tkey, payload
                 ),
                 clock_refresh=_clock_publish,
+                # the same snapshot also lands in the on-disk history
+                # — and arms the memledger crosscheck gauge — at zero
+                # extra RPCs
+                tsdb=tsdb,
             ).start()
 
     def _telemetry_stop(self) -> None:

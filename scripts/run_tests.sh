@@ -400,6 +400,70 @@ python -m edl_tpu.cli check --baseline analysis_baseline.json \
 rm -rf "$KVQDIR"
 t14=$(date +%s)
 echo "== phase 14 done in $((t14 - t13))s (rc=$rc14) =="
-echo "== total $((t14 - t0))s =="
 
-[ "$rc0" -eq 0 ] && [ "$rc1" -eq 0 ] && [ "$rc2" -eq 0 ] && [ "$rc3" -eq 0 ] && [ "$rc4" -eq 0 ] && [ "$rc5" -eq 0 ] && [ "$rc6" -eq 0 ] && [ "$rc7" -eq 0 ] && [ "$rc8" -eq 0 ] && [ "$rc9" -eq 0 ] && [ "$rc10" -eq 0 ] && [ "$rc11" -eq 0 ] && [ "$rc12" -eq 0 ] && [ "$rc13" -eq 0 ] && [ "$rc14" -eq 0 ]
+echo "== phase 15: alerting chaos lane (burn-rate fire/resolve + false-positive twin) =="
+# Two seeded dryrun loadgen runs record metric history into ONE tsdb
+# dir: the first under a serve.dispatch:delay plan (every decode
+# dispatch stalls 0.5 s, so every interactive request blows its
+# 0.25 s/token ITL SLO and the --slo-window'd attainment gauge
+# collapses to 0), the second fault-free (the gauge recovers to 1).
+# `edl watch --once` replays that history against a fast-burn
+# page (short/long windows scaled 0.01 -> 3 s / 36 s) and must see
+# exactly FIRE then RESOLVE — an alert that cannot fire, or never
+# resolves, is recovery code only this lane exercises. Gates:
+#   (a) the replay's transition list is fire -> resolve for the rule
+#       and the watch exit code is 0 (nothing still paging);
+#   (b) `edl postmortem --assert-recovered --sites alert.` over the
+#       watch's --events-out dump proves the incident chain closed;
+#   (c) a fault-free twin replay over clean-run-only history records
+#       ZERO transitions (the false-positive gate).
+WDIR="${TMPDIR:-/tmp}/edl-watch.$$"
+rm -rf "$WDIR"; mkdir -p "$WDIR"
+rc15=0
+cat > "$WDIR/rules.json" <<'JSON'
+{"time_scale": 1.0, "rules": [
+  {"type": "burn_rate", "name": "itl_fast_burn",
+   "series": "edl_slo_itl_ok_ratio", "labels": {"slo_class": "interactive"},
+   "objective": 0.9, "short_s": 300.0, "long_s": 3600.0,
+   "factor": 4.0, "severity": "page"}
+]}
+JSON
+# faulted run, then clean run, appending to the same history dir
+# (tsdb segment numbering continues across reopen — no clobber)
+EDL_FAULTS="serve.dispatch:delay@every=1,s=0.5" \
+JAX_PLATFORMS=cpu python -m edl_tpu.cli loadgen --dryrun --seed 0 \
+    --json --slo-window 2 --tsdb-dir "$WDIR/tsdb" > /dev/null || rc15=1
+JAX_PLATFORMS=cpu python -m edl_tpu.cli loadgen --dryrun --seed 0 \
+    --json --slo-window 2 --tsdb-dir "$WDIR/tsdb" > /dev/null \
+  && JAX_PLATFORMS=cpu python -m edl_tpu.cli loadgen --dryrun --seed 1 \
+    --json --slo-window 2 --tsdb-dir "$WDIR/tsdb-clean" > /dev/null \
+  || rc15=1
+JAX_PLATFORMS=cpu python -m edl_tpu.cli watch "$WDIR/tsdb" --once --json \
+    --time-scale 0.01 --rules "$WDIR/rules.json" \
+    --events-out "$WDIR/ev.jsonl" > "$WDIR/watch.json" \
+  || { echo "watch exit != 0 (page still active or scrape error)"; rc15=1; }
+JAX_PLATFORMS=cpu python -m edl_tpu.cli watch "$WDIR/tsdb-clean" --once \
+    --json --time-scale 0.01 --rules "$WDIR/rules.json" \
+    > "$WDIR/twin.json" || rc15=1
+python - "$WDIR/watch.json" "$WDIR/twin.json" <<'PY' || rc15=1
+import json, sys
+w = json.load(open(sys.argv[1]))
+trs = [(t["transition"], t["rule"]) for t in w["transitions"]]
+assert trs == [("fire", "itl_fast_burn"), ("resolve", "itl_fast_burn")], \
+    f"fault lane: want fire->resolve for itl_fast_burn, got {trs}"
+assert w["fired_total"] == 1 and not w["active"], w
+twin = json.load(open(sys.argv[2]))
+assert twin["transitions"] == [] and twin["fired_total"] == 0, \
+    f"false-positive gate: fault-free twin alerted: {twin['transitions']}"
+print(f"alert lane OK: fire->resolve replayed, twin clean "
+      f"(time_scale {w['time_scale']})")
+PY
+python -m edl_tpu.cli postmortem "$WDIR/ev.jsonl" --assert-recovered \
+    --sites alert. > /dev/null \
+  || { echo "postmortem FAILED for $WDIR/ev.jsonl (alert.*)"; rc15=1; }
+rm -rf "$WDIR"
+t15=$(date +%s)
+echo "== phase 15 done in $((t15 - t14))s (rc=$rc15) =="
+echo "== total $((t15 - t0))s =="
+
+[ "$rc0" -eq 0 ] && [ "$rc1" -eq 0 ] && [ "$rc2" -eq 0 ] && [ "$rc3" -eq 0 ] && [ "$rc4" -eq 0 ] && [ "$rc5" -eq 0 ] && [ "$rc6" -eq 0 ] && [ "$rc7" -eq 0 ] && [ "$rc8" -eq 0 ] && [ "$rc9" -eq 0 ] && [ "$rc10" -eq 0 ] && [ "$rc11" -eq 0 ] && [ "$rc12" -eq 0 ] && [ "$rc13" -eq 0 ] && [ "$rc14" -eq 0 ] && [ "$rc15" -eq 0 ]

@@ -1,0 +1,349 @@
+"""Alert engine (edl_tpu/obs/alerts.py): threshold/burn-rate/anomaly
+rules over a recorded history, the fire/resolve state machine with
+for_s debounce, flight-recorder + gauge observability of transitions,
+postmortem alert chains, and the shipped DEFAULT_RULES doc. jax-free."""
+
+import json
+import math
+
+import pytest
+
+from edl_tpu.obs import TSDB, MetricsRegistry, alerts, postmortem
+from edl_tpu.obs.alerts import (
+    DEFAULT_RULES,
+    AlertEngine,
+    AnomalyRule,
+    BurnRateRule,
+    ThresholdRule,
+    engine_from_doc,
+    load_rules_doc,
+    parse_rules,
+)
+from edl_tpu.obs.events import FlightRecorder
+from edl_tpu.obs.metrics import ensure_core_series
+
+
+def db_with_gauge(tmp_path, name, values, t0=1000.0, dt=1.0,
+                  labels=None, labelnames=()):
+    db = TSDB(str(tmp_path / "h"))
+    for i, v in enumerate(values):
+        r = MetricsRegistry()
+        r.gauge(name, "g", tuple(labelnames)).set(v, **(labels or {}))
+        db.append(r.snapshot(), t=t0 + i * dt)
+    return db
+
+
+# ---------------------------------------------------------------------------
+# threshold
+
+
+def test_threshold_fire_and_resolve_with_events_and_gauges(tmp_path):
+    rec = FlightRecorder()
+    reg = MetricsRegistry()
+    engine = AlertEngine(
+        [ThresholdRule("hot", "edl_temp", op=">", value=5.0,
+                       window_s=10.0, agg="max", severity="page")],
+        registry=reg, recorder=rec,
+    )
+    db = db_with_gauge(tmp_path, "edl_temp", [1.0, 2.0, 9.0])
+
+    trs = engine.evaluate(db, 1002.5)
+    assert [t["transition"] for t in trs] == ["fire"]
+    assert engine.pages() == 1
+    assert engine.active()[0]["value"] == 9.0
+
+    # the window slides past the spike -> resolve
+    trs = engine.evaluate(db, 1020.0)
+    assert [t["transition"] for t in trs] == ["resolve"]
+    assert engine.active() == []
+
+    kinds = [e["kind"] for e in rec.records()]
+    assert kinds == ["alert.fire", "alert.resolve"]
+    fire = rec.records()[0]
+    assert fire["corr"]["site"] == "alert.hot"
+    assert fire["severity"] == "error"  # a page is an incident error
+
+    fams = {f["name"] for f in reg.snapshot()["families"]}
+    assert "edl_alerts_active" in fams
+    assert "edl_alerts_fired_total" in fams
+    text = reg.render()
+    assert 'edl_alerts_active{severity="page"} 0' in text
+    assert 'edl_alerts_fired_total{rule="hot"} 1' in text
+
+
+def test_threshold_empty_window_never_fires(tmp_path):
+    engine = AlertEngine(
+        [ThresholdRule("hot", "edl_temp", op=">", value=0.0)]
+    )
+    db = TSDB(str(tmp_path / "h"))
+    assert engine.evaluate(db, 1000.0) == []
+    assert engine.active() == []
+
+
+def test_for_s_debounce_requires_sustained_condition(tmp_path):
+    engine = AlertEngine(
+        [ThresholdRule("hot", "edl_temp", op=">", value=5.0,
+                       window_s=5.0, for_s=3.0)]
+    )
+    db = db_with_gauge(tmp_path, "edl_temp", [9.0] * 20)
+    assert engine.evaluate(db, 1001.0) == []  # pending, not fired
+    assert engine.evaluate(db, 1002.0) == []
+    trs = engine.evaluate(db, 1004.5)  # held > for_s
+    assert [t["transition"] for t in trs] == ["fire"]
+
+
+# ---------------------------------------------------------------------------
+# burn rate
+
+
+def burn_db(tmp_path, ratios, t0=1000.0):
+    return db_with_gauge(
+        tmp_path, "edl_slo_goodput_fraction", ratios, t0=t0
+    )
+
+
+def test_burn_rate_requires_both_windows(tmp_path):
+    """A short blip trips the SHORT window but not the LONG one — no
+    page (the whole point of the multi-window shape)."""
+    rule = BurnRateRule(
+        "gp", "edl_slo_goodput_fraction", objective=0.95,
+        short_s=3.0, long_s=30.0, factor=14.4,
+    )
+    engine = AlertEngine([rule])
+    # 28 clean samples, 2 bad: short window burns, long window doesn't
+    db = burn_db(tmp_path, [1.0] * 28 + [0.0] * 2)
+    assert engine.evaluate(db, 1029.0) == []
+
+    # sustained breach: both windows above factor -> fire
+    db2 = burn_db(tmp_path / "b", [1.0] * 5 + [0.0] * 25)
+    trs = engine.evaluate(db2, 1029.0)
+    assert [t["transition"] for t in trs] == ["fire"]
+    assert trs[0]["burn_short"] > 14.4 and trs[0]["burn_long"] > 14.4
+
+
+def test_burn_rate_resolves_when_recent_window_is_clean(tmp_path):
+    rule = BurnRateRule(
+        "gp", "edl_slo_goodput_fraction", objective=0.95,
+        short_s=3.0, long_s=30.0, factor=14.4,
+    )
+    engine = AlertEngine([rule])
+    # outage then recovery: the short window goes clean first
+    db = burn_db(tmp_path, [0.0] * 20 + [1.0] * 10)
+    assert [t["transition"] for t in engine.evaluate(db, 1015.0)] == ["fire"]
+    trs = engine.evaluate(db, 1029.0)
+    assert [t["transition"] for t in trs] == ["resolve"]
+    assert trs[0]["active_s"] == pytest.approx(14.0)
+
+
+def test_burn_rate_validation():
+    with pytest.raises(ValueError):
+        BurnRateRule("r", "edl_x", objective=1.5)
+    with pytest.raises(ValueError):
+        BurnRateRule("r", "edl_x", short_s=600.0, long_s=300.0)
+
+
+def test_time_scale_shrinks_every_window(tmp_path):
+    """time_scale=0.01 turns the production 300s/3600s pair into
+    3s/36s — the same rules file drives the CI replay lane."""
+    doc = {
+        "time_scale": 0.01,
+        "rules": [{
+            "type": "burn_rate", "name": "gp",
+            "series": "edl_slo_goodput_fraction",
+            "objective": 0.95, "short_s": 300.0, "long_s": 3600.0,
+            "factor": 14.4, "severity": "page",
+        }],
+    }
+    engine = engine_from_doc(doc)
+    rule = engine.rules[0]
+    assert rule.short_s == pytest.approx(3.0)
+    assert rule.long_s == pytest.approx(36.0)
+    db = burn_db(tmp_path, [1.0] * 5 + [0.0] * 25)
+    assert [t["transition"] for t in engine.evaluate(db, 1029.0)] == ["fire"]
+
+
+# ---------------------------------------------------------------------------
+# anomaly
+
+
+def test_anomaly_fires_on_spike_not_on_flat(tmp_path):
+    rule = AnomalyRule("an", "edl_temp", mode="value", window_s=100.0,
+                       z=8.0, min_points=12)
+    engine = AlertEngine([rule])
+    flat = db_with_gauge(tmp_path / "flat", "edl_temp", [5.0] * 20)
+    assert engine.evaluate(flat, 1019.5) == []  # band floor holds
+
+    spiky = db_with_gauge(
+        tmp_path / "spiky", "edl_temp", [5.0] * 19 + [500.0]
+    )
+    trs = engine.evaluate(spiky, 1019.5)
+    assert [t["transition"] for t in trs] == ["fire"]
+    assert trs[0]["robust_z"] > 8.0
+
+
+def test_anomaly_needs_min_points(tmp_path):
+    rule = AnomalyRule("an", "edl_temp", mode="value", window_s=100.0,
+                       z=1.0, min_points=12)
+    engine = AlertEngine([rule])
+    db = db_with_gauge(tmp_path, "edl_temp", [5.0, 5.0, 500.0])
+    assert engine.evaluate(db, 1002.5) == []  # too few samples to judge
+
+
+def test_anomaly_increase_mode_survives_counter_reset(tmp_path):
+    """Per-step increases are reset-clamped, so a process restart is a
+    normal-sized step (its post-reset count), not the giant negative
+    outlier a naive delta would produce."""
+    db = TSDB(str(tmp_path / "h"))
+    # cumulative counter stepping +1/+2 alternately, restarting at 2:
+    # clamped increases stay in the 1..2 family across the restart
+    vals = [0.0, 1.0, 3.0, 4.0, 6.0, 7.0, 9.0, 10.0, 12.0, 13.0,
+            15.0, 2.0, 3.0, 5.0, 6.0, 8.0]
+    for i, v in enumerate(vals):
+        r = MetricsRegistry()
+        r.counter("edl_test_total", "c").inc(v)
+        db.append(r.snapshot(), t=1000.0 + i)
+    rule = AnomalyRule("an", "edl_test_total", mode="increase",
+                       window_s=100.0, z=8.0, min_points=12)
+    engine = AlertEngine([rule])
+    assert engine.evaluate(db, 1015.5) == []
+
+
+# ---------------------------------------------------------------------------
+# doc parsing / defaults
+
+
+def test_parse_rules_rejects_bad_docs():
+    with pytest.raises(ValueError, match="unknown rule type"):
+        parse_rules({"rules": [{"type": "nope", "name": "r",
+                                "series": "edl_x"}]})
+    with pytest.raises(ValueError, match="duplicate rule name"):
+        parse_rules({"rules": [
+            {"type": "threshold", "name": "r", "series": "edl_x"},
+            {"type": "threshold", "name": "r", "series": "edl_y"},
+        ]})
+    with pytest.raises(ValueError, match="names no series"):
+        parse_rules({"rules": [{"type": "threshold", "name": "r"}]})
+    with pytest.raises(ValueError, match="severity"):
+        parse_rules({"rules": [{"type": "threshold", "name": "r",
+                                "series": "edl_x", "severity": "sev1"}]})
+
+
+def test_default_rules_parse_and_series_exist():
+    """Every series the shipped rules watch exists in the core
+    catalog — the static analyzer pins the same property, this pins it
+    at runtime against ensure_core_series."""
+    rules = parse_rules(load_rules_doc())
+    assert len(rules) == len(DEFAULT_RULES["rules"])
+    reg = ensure_core_series(MetricsRegistry())
+    registered = {f["name"] for f in reg.snapshot()["families"]}
+    for rule in rules:
+        assert rule.series in registered, rule.name
+
+
+def test_load_rules_doc_returns_deep_copy():
+    doc = load_rules_doc()
+    doc["rules"][0]["objective"] = 0.5
+    assert DEFAULT_RULES["rules"][0]["objective"] != 0.5
+
+
+def test_engine_rejects_bad_time_scale():
+    with pytest.raises(ValueError):
+        AlertEngine([], time_scale=0.0)
+
+
+def test_to_block_is_jsonable(tmp_path):
+    engine = AlertEngine(
+        [ThresholdRule("hot", "edl_temp", op=">", value=5.0,
+                       window_s=10.0)]
+    )
+    db = db_with_gauge(tmp_path, "edl_temp", [9.0] * 3)
+    engine.evaluate(db, 1002.5)
+    block = json.loads(json.dumps(engine.to_block()))
+    assert block["fired_total"] == 1
+    assert block["active"][0]["rule"] == "hot"
+    assert block["last_transition"]["transition"] == "fire"
+
+
+def test_broken_rule_does_not_blind_the_engine(tmp_path):
+    class Exploding(alerts.Rule):
+        def firing(self, db, now):
+            raise RuntimeError("boom")
+
+    engine = AlertEngine([
+        Exploding("bad"),
+        ThresholdRule("hot", "edl_temp", op=">", value=5.0,
+                      window_s=10.0),
+    ])
+    db = db_with_gauge(tmp_path, "edl_temp", [9.0] * 3)
+    trs = engine.evaluate(db, 1002.5)
+    assert [t["rule"] for t in trs] == ["hot"]
+
+
+# ---------------------------------------------------------------------------
+# postmortem integration
+
+
+def rec_events(*emits):
+    rec = FlightRecorder()
+    for kind, site, sev in emits:
+        rec.emit(kind, severity=sev, site=site)
+    return rec.records()
+
+
+def test_alert_chains_open_incident_is_a_problem():
+    evs = rec_events(("alert.fire", "alert.gp_fast", "error"))
+    chains = postmortem.alert_chains(evs)
+    assert len(chains) == 1 and not chains[0]["ok"]
+    assert "never resolved" in chains[0]["problems"][0]
+
+    evs = rec_events(
+        ("alert.fire", "alert.gp_fast", "error"),
+        ("alert.resolve", "alert.gp_fast", "info"),
+    )
+    chains = postmortem.alert_chains(evs)
+    assert len(chains) == 1 and chains[0]["ok"]
+
+
+def test_verify_recovered_over_alert_sites():
+    complete = rec_events(
+        ("alert.fire", "alert.gp_fast", "error"),
+        ("alert.resolve", "alert.gp_fast", "info"),
+    )
+    assert postmortem.verify_recovered(complete, "alert.") == []
+
+    open_incident = rec_events(("alert.fire", "alert.gp_fast", "error"))
+    problems = postmortem.verify_recovered(open_incident, "alert.")
+    assert any("never resolved" in p for p in problems)
+
+    # a lane that produced neither faults nor alerts asserts nothing
+    problems = postmortem.verify_recovered([], "alert.")
+    assert problems and "no injected faults or fired alerts" in problems[0]
+
+
+# ---------------------------------------------------------------------------
+# monitor surface
+
+
+def test_monitor_sample_carries_alerts_block(tmp_path):
+    from edl_tpu.monitor.collector import Collector, MonitorSample
+
+    engine = AlertEngine(
+        [ThresholdRule("hot", "edl_temp", op=">", value=5.0,
+                       window_s=10.0, severity="page")]
+    )
+    db = db_with_gauge(tmp_path, "edl_temp", [9.0] * 3)
+
+    class _Src:
+        def sample(self):
+            return MonitorSample(ts=1002.5)
+
+    def alerts_source():
+        engine.evaluate(db, 1002.5)
+        return engine.to_block()
+
+    c = Collector(_Src(), alerts_source=alerts_source)
+    s = c.poll()
+    assert s.alerts["active"][0]["rule"] == "hot"
+    rec = s.to_record()
+    assert rec["alerts"]["fired_total"] == 1
+    assert "ALERTS: hot[page]" in s.render()

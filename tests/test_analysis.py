@@ -668,6 +668,57 @@ def test_telemetry_fault_site_coverage(tmp_path):
     assert "'obscure.site' is not referenced" in covered.findings[0].message
 
 
+def test_telemetry_alert_rules_series_must_exist(tmp_path):
+    """A DEFAULT_RULES entry watching a series nothing registers is an
+    error — an alert rule over a typo'd name silently never fires."""
+    rep = run_on(tmp_path, """
+    DEFAULT_RULES = {
+        "time_scale": 1.0,
+        "rules": [
+            {"name": "ok_rule", "type": "threshold",
+             "series": "edl_widgets_total", "op": ">", "value": 1.0},
+            {"name": "ghost_rule", "type": "threshold",
+             "series": "edl_ghost_series", "op": ">", "value": 1.0},
+        ],
+    }
+
+    def instrument(reg):
+        reg.counter("edl_widgets_total", "exists")
+    """, rules=["telemetry-conventions"])
+    assert len(rep.findings) == 1
+    f = rep.findings[0]
+    assert "ghost_rule" in f.message and "'edl_ghost_series'" in f.message
+    assert f.severity == "error"
+
+
+def test_telemetry_alert_rules_skip_partial_runs(tmp_path):
+    """With no registrations in scope (a partial run over one file),
+    the series check cannot judge and stays silent."""
+    rep = run_on(tmp_path, """
+    DEFAULT_RULES = {
+        "rules": [
+            {"name": "r", "type": "threshold",
+             "series": "edl_anything", "op": ">", "value": 1.0},
+        ],
+    }
+    """, rules=["telemetry-conventions"])
+    assert rep.findings == []
+
+
+def test_telemetry_alert_namespace_kinds(tmp_path):
+    """Only alert.fire / alert.resolve may live in the alert.* event
+    namespace — postmortem's incident chainer pairs exactly those."""
+    rep = run_on(tmp_path, """
+    def transitions(events):
+        events.emit("alert.fired", rule="r")    # wrong spelling
+        events.emit("alert.fire", rule="r")     # fine
+        events.emit("alert.resolve", rule="r")  # fine
+    """, rules=["telemetry-conventions"])
+    assert len(rep.findings) == 1
+    assert "alert.* namespace" in rep.findings[0].message
+    assert "'alert.fired'" in rep.findings[0].message
+
+
 # ---------------------------------------------------------------------------
 # kv-block
 

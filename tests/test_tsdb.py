@@ -1,0 +1,297 @@
+"""On-disk metric history (edl_tpu/obs/tsdb.py): round-trip, exact
+downsampling, retention under a byte budget, counter-reset clamping,
+the /history endpoint, and `edl watch --once --json` determinism over
+a recorded directory. jax-free — the tsdb is stdlib-only."""
+
+import json
+import math
+
+import pytest
+
+from edl_tpu.cli.main import main as cli_main
+from edl_tpu.obs import (
+    TSDB,
+    MetricsRegistry,
+    scrape,
+    series_key,
+    snapshot_from_prometheus_text,
+    start_exporter,
+)
+from edl_tpu.obs.metrics import percentile_from_buckets
+from edl_tpu.obs.tsdb import flatten_snapshot, parse_series_key
+
+
+def reg_with(value: float, count_v: float = 0.0) -> MetricsRegistry:
+    r = MetricsRegistry()
+    r.gauge("edl_test_gauge", "g").set(value)
+    if count_v:
+        r.counter("edl_test_total", "c").inc(count_v)
+    return r
+
+
+def test_series_key_roundtrip():
+    key = series_key("edl_x", {"b": "2", "a": "1"})
+    assert key == "edl_x{a=1,b=2}"  # sorted -> canonical
+    assert parse_series_key(key) == ("edl_x", {"a": "1", "b": "2"})
+
+
+def test_append_rejects_non_snapshot(tmp_path):
+    db = TSDB(str(tmp_path / "h"))
+    with pytest.raises(ValueError):
+        db.append({"not": "a snapshot"}, t=1.0)
+
+
+def test_append_accepts_snapshot_json_string(tmp_path):
+    db = TSDB(str(tmp_path / "h"))
+    r = reg_with(3.5)
+    db.append(r.snapshot_json(), t=100.0)
+    assert db.points("edl_test_gauge") == [(100.0, 3.5)]
+
+
+def test_downsample_preserves_window_aggregates_exactly(tmp_path):
+    """The acceptance pin: a closed 10s bucket carries the EXACT
+    sum/cnt/min/max of the raw points inside it — downsampling loses
+    resolution, never arithmetic."""
+    db = TSDB(str(tmp_path / "h"))
+    vals = [float(v) for v in (5, 1, 9, 4, 7, 2, 8, 3, 6, 0)]
+    for i, v in enumerate(vals):
+        r = MetricsRegistry()
+        r.gauge("edl_test_gauge", "g").set(v)
+        db.append(r.snapshot(), t=1000.0 + i)  # all inside [1000, 1010)
+    db.append(reg_with(99.0).snapshot(), t=1011.0)  # closes the bucket
+
+    recs = list(db._iter_tier(10.0, 1000.0, 1010.0))
+    closed = [r for r in recs if r["t0"] == 1000.0]
+    assert len(closed) == 1
+    agg = closed[0]["series"][series_key("edl_test_gauge")]
+    assert agg["sum"] == sum(vals)
+    assert agg["cnt"] == len(vals)
+    assert agg["min"] == min(vals)
+    assert agg["max"] == max(vals)
+    assert agg["last"] == vals[-1]
+
+    # and the query path folds the same numbers back out
+    buckets = db.series("edl_test_gauge", t0=1000.0, t1=1009.5, step=10.0)
+    assert buckets[0]["sum"] == sum(vals)
+    assert buckets[0]["avg"] == pytest.approx(sum(vals) / len(vals))
+
+
+def test_retention_enforces_byte_budget_not_coverage(tmp_path):
+    """Over budget, the oldest RAW segment goes first — the early
+    window survives in the downsample tiers (degraded resolution,
+    intact coverage)."""
+    db = TSDB(
+        str(tmp_path / "h"), segment_bytes=4096, max_bytes=24 << 10
+    )
+    for i in range(400):
+        r = MetricsRegistry()
+        r.gauge("edl_test_gauge", "g").set(float(i))
+        db.append(r.snapshot(), t=1000.0 + 2.0 * i)
+    db.flush()
+    assert db.total_bytes() <= 24 << 10
+    kinds = {k for _, k, _ in db._segments()}
+    assert "raw" in kinds and "agg10" in kinds
+    # earliest raw appends were retained out — but the tier still
+    # answers for that window (points falls back to bucket `last`)
+    assert db.raw_times()[0] > 1000.0
+    early = db.points("edl_test_gauge", t0=1000.0, t1=1100.0)
+    assert early, "retention must not create a coverage hole"
+
+
+def test_counter_reset_clamps_increase(tmp_path):
+    """increase() over a restarting counter: 5 -> 10 -> (restart) 3 ->
+    4 is an increase of 9 (5 up, then 3 counted from zero, then 1) —
+    never the naive negative delta."""
+    db = TSDB(str(tmp_path / "h"))
+    for i, v in enumerate((5.0, 10.0, 3.0, 4.0)):
+        r = MetricsRegistry()
+        r.counter("edl_test_total", "c").inc(v)
+        db.append(r.snapshot(), t=100.0 + i)
+    assert db.increase("edl_test_total") == 9.0
+    assert db.increase("edl_test_total", t0=100.0, t1=101.0) == 5.0
+
+
+def hist_reg(samples) -> MetricsRegistry:
+    r = MetricsRegistry()
+    h = r.histogram(
+        "edl_test_seconds", "h", buckets=(0.1, 0.5, 1.0)
+    )
+    for s in samples:
+        h.observe(s)
+    return r
+
+
+def test_hist_delta_windowed_percentiles(tmp_path):
+    db = TSDB(str(tmp_path / "h"))
+    db.append(hist_reg([0.05]).snapshot(), t=100.0)
+    # one process accumulating: +3 fast, +1 slow in the window
+    db.append(
+        hist_reg([0.05, 0.05, 0.05, 0.05, 0.8]).snapshot(), t=110.0
+    )
+    d = db.hist_delta("edl_test_seconds", t0=99.0, t1=111.0)
+    assert d["count"] == 4.0
+    # delta = 3 in le=0.1, 1 in le=1.0: p50 within the fast bucket
+    assert percentile_from_buckets(d["pairs"], 0.5) <= 0.1
+    assert percentile_from_buckets(d["pairs"], 0.99) > 0.5
+
+
+def test_hist_delta_restart_clamps_to_later_sample(tmp_path):
+    """Total count DROPPED between window edges -> the process
+    restarted; the later cumulative sample IS the window delta (no
+    negative bucket counts, ever)."""
+    db = TSDB(str(tmp_path / "h"))
+    db.append(hist_reg([0.05] * 10).snapshot(), t=100.0)
+    db.append(hist_reg([0.8, 0.8]).snapshot(), t=110.0)  # restarted
+    d = db.hist_delta("edl_test_seconds", t0=99.0, t1=111.0)
+    assert d["count"] == 2.0
+    assert all(v >= 0.0 for _, v in d["pairs"])
+    assert percentile_from_buckets(d["pairs"], 0.5) > 0.5
+
+
+def test_series_single_bucket_when_step_none(tmp_path):
+    db = TSDB(str(tmp_path / "h"))
+    for i in range(5):
+        r = MetricsRegistry()
+        r.gauge("edl_test_gauge", "g").set(float(i))
+        db.append(r.snapshot(), t=100.0 + i)
+    buckets = db.series("edl_test_gauge", t0=100.0, t1=104.0)
+    assert len(buckets) == 1  # the alert engine's whole-window read
+    assert buckets[0]["cnt"] == 5.0
+    assert buckets[0]["last"] == 4.0
+
+
+def test_snapshot_from_prometheus_text_roundtrip(tmp_path):
+    r = MetricsRegistry()
+    r.gauge("edl_test_gauge", "g", ("cls",)).set(0.75, cls="a")
+    snap = snapshot_from_prometheus_text(r.render())
+    db = TSDB(str(tmp_path / "h"))
+    db.append(snap, t=100.0)
+    assert db.points("edl_test_gauge", {"cls": "a"}) == [(100.0, 0.75)]
+
+
+def test_flatten_snapshot_splits_kinds():
+    r = hist_reg([0.05])
+    r.gauge("edl_test_gauge", "g").set(1.0)
+    scalars, hists = flatten_snapshot(r.snapshot())
+    assert series_key("edl_test_gauge") in scalars
+    assert series_key("edl_test_seconds") in hists
+    h = hists[series_key("edl_test_seconds")]
+    assert h["count"] == 1.0 and len(h["counts"]) == len(h["buckets"]) + 1
+
+
+def test_history_endpoint_over_live_exporter(tmp_path):
+    db = TSDB(str(tmp_path / "h"))
+    for i in range(3):
+        r = MetricsRegistry()
+        r.gauge("edl_test_gauge", "g").set(float(i))
+        db.append(r.snapshot(), t=100.0 + i)
+    exp = start_exporter(lambda: MetricsRegistry(), history=db)
+    try:
+        hz = json.loads(scrape(exp.url, "/healthz"))
+        assert "/history" in hz["endpoints"]
+        idx = json.loads(scrape(exp.url, "/history"))
+        assert series_key("edl_test_gauge") in idx["series"]
+        doc = json.loads(
+            scrape(exp.url, "/history?name=edl_test_gauge")
+        )
+        assert doc["points"] == [[100.0, 0.0], [101.0, 1.0], [102.0, 2.0]]
+        stepped = json.loads(scrape(
+            exp.url, "/history?name=edl_test_gauge&t0=100&t1=103&step=10"
+        ))
+        assert stepped["points"][0]["sum"] == 3.0
+    finally:
+        exp.stop()
+
+
+def test_history_404_without_store():
+    exp = start_exporter(lambda: MetricsRegistry())
+    try:
+        hz = json.loads(scrape(exp.url, "/healthz"))
+        assert "/history" not in hz["endpoints"]
+        with pytest.raises(Exception):
+            scrape(exp.url, "/history")
+    finally:
+        exp.stop()
+
+
+def record_slo_dir(tmp_path, ratios):
+    """A recorded directory with an interactive-TTFT ratio series —
+    what a loadgen --tsdb-dir run leaves behind."""
+    db = TSDB(str(tmp_path / "rec"))
+    for i, v in enumerate(ratios):
+        r = MetricsRegistry()
+        r.gauge(
+            "edl_slo_ttft_ok_ratio", "ok", ("slo_class",)
+        ).set(v, slo_class="interactive")
+        r.gauge("edl_slo_goodput_fraction", "gp").set(v)
+        db.append(r.snapshot(), t=1000.0 + i)
+    db.flush()
+    return str(tmp_path / "rec")
+
+
+def test_watch_once_json_is_deterministic(tmp_path, capsys):
+    """Replaying the SAME recorded directory twice produces byte-equal
+    summaries — the property the CI lane's assertions stand on."""
+    rec = record_slo_dir(tmp_path, [1.0] * 30)
+    rc1 = cli_main(["watch", rec, "--once", "--json"])
+    out1 = capsys.readouterr().out
+    rc2 = cli_main(["watch", rec, "--once", "--json"])
+    out2 = capsys.readouterr().out
+    assert (rc1, out1) == (rc2, out2)
+    summary = json.loads(out1)
+    assert summary["transitions"] == []
+    assert summary["fired_total"] == 0
+    assert rc1 == 0
+
+
+def test_watch_replay_fires_and_exit_code_counts_pages(tmp_path, capsys):
+    """A recorded outage (ratio collapses, stays down) fires the
+    fast-burn page on replay, and `--once` exits with the page count."""
+    rec = record_slo_dir(tmp_path, [1.0] * 5 + [0.0] * 25)
+    rules = {
+        "time_scale": 1.0,
+        "rules": [{
+            "type": "burn_rate", "name": "gp_fast",
+            "series": "edl_slo_goodput_fraction", "labels": {},
+            "objective": 0.95, "short_s": 3.0, "long_s": 30.0,
+            "factor": 14.4, "severity": "page",
+        }],
+    }
+    rp = tmp_path / "rules.json"
+    rp.write_text(json.dumps(rules))
+    rc = cli_main([
+        "watch", rec, "--once", "--json", "--rules", str(rp),
+    ])
+    summary = json.loads(capsys.readouterr().out)
+    assert rc == 1  # one active page at end of replay
+    assert summary["fired_total"] == 1
+    assert summary["transitions"][0]["rule"] == "gp_fast"
+    assert summary["transitions"][0]["transition"] == "fire"
+
+
+def test_watch_events_out_chains_in_postmortem(tmp_path, capsys):
+    """--events-out dumps the watch process's flight-recorder window;
+    a fired-but-unresolved alert shows up as a postmortem problem."""
+    from edl_tpu.obs import postmortem
+
+    rec = record_slo_dir(tmp_path, [1.0] * 5 + [0.0] * 25)
+    rules = {
+        "time_scale": 1.0,
+        "rules": [{
+            "type": "burn_rate", "name": "gp_fast",
+            "series": "edl_slo_goodput_fraction", "labels": {},
+            "objective": 0.95, "short_s": 3.0, "long_s": 30.0,
+            "factor": 14.4, "severity": "page",
+        }],
+    }
+    rp = tmp_path / "rules.json"
+    rp.write_text(json.dumps(rules))
+    ev = tmp_path / "events.jsonl"
+    cli_main([
+        "watch", rec, "--once", "--json", "--rules", str(rp),
+        "--events-out", str(ev),
+    ])
+    capsys.readouterr()
+    events = postmortem.load_events(str(ev))
+    problems = postmortem.verify_recovered(events, site_prefix="alert.")
+    assert any("never resolved" in p for p in problems)
