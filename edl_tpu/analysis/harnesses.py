@@ -551,6 +551,65 @@ def _lease_broker(mutate: bool) -> None:
     assert b.get(stuck.lease_id).state == FREED
 
 
+def _dist_lease_broker(mutate: bool) -> None:
+    import threading
+
+    from edl_tpu.runtime.lease_table import FREED, LeaseTable
+
+    # a broker restart mid-flight: one live lease persisted, then the
+    # table restored into RECOVERING with a zero re-confirmation window
+    docs: list = []
+    t0 = LeaseTable(persist=docs.append, recover_window_s=0.0)
+    t0.init(4)
+    g = t0.grant("serve:old", 4, token="tok-old")
+    table = LeaseTable(recover_window_s=0.0)
+    table.restore(docs[-1])
+    assert table.recovering
+    if mutate:
+        # strip the epoch fence: confirm stops comparing the holder's
+        # remembered epoch against the lease's
+        table._stale_locked = lambda row, epoch: False
+    instrument(table, ["_free", "_epoch", "_recovering"], name="LeaseTable")
+    table._leases = TrackedDict("LeaseTable._leases", table._leases)
+
+    results: dict = {}
+
+    # the zombie: a holder whose memory of its lease predates the
+    # restart — wrong epoch. The fence must never answer "ok".
+    def zombie() -> None:
+        checkpoint("zombie-gap")
+        results["zombie"] = table.confirm(g["id"], g["epoch"] - 1)
+
+    # the reaper + the next tenant: force-release the silent holder,
+    # then re-grant the same chips
+    def reaper() -> None:
+        table.expire()
+        checkpoint("regrant-gap")
+        results["regrant"] = table.grant("serve:new", 4, token="tok-new")
+
+    t1 = threading.Thread(target=zombie, name="zombie")
+    t2 = threading.Thread(target=reaper, name="reaper")
+    t1.start()
+    t2.start()
+    t1.join()
+    t2.join()
+    # conservation in every interleaving
+    assert table.check_conservation(), table.snap()
+    # the fence: a stale-epoch confirm is NEVER accepted — with
+    # _stale_locked stripped the zombie's confirm lands "ok", recovery
+    # ends without force-releasing, and the zombie keeps chips the
+    # reaper should have reclaimed
+    assert results["zombie"] != "ok", (
+        f"stale-confirm accepted: zombie confirmed epoch "
+        f"{g['epoch'] - 1} against lease epoch {g['epoch']}"
+    )
+    # whoever lost the race, the chips ended in exactly one place: the
+    # old lease force-released and re-granted, or still held pending
+    # the next reaper sweep — never both
+    live = [l for l in table.snap()["leases"] if l["state"] != FREED]
+    assert sum(l["chips"] for l in live) + table.snap()["free"] == 4
+
+
 def _kube_rv() -> None:
     import threading
 
@@ -632,6 +691,10 @@ HARNESSES: Dict[str, Harness] = {
             "elasticity ChipLeaseBroker: granter vs recall/free vs "
             "holder-crash under _lock (expect race-free; conservation "
             "+ epoch monotonicity at quiescence)"),
+        _mk("dist-lease-broker", lambda: _dist_lease_broker(False),
+            "coordinator LeaseTable in RECOVERING: zombie stale-epoch "
+            "confirm vs expire-reaper + re-grant (expect race-free; "
+            "conservation + the fence never answers ok)"),
         _mk("kube-rv", lambda: _kube_rv(),
             "KubeJobSource relist/close vs watch thread: witnesses the "
             "baselined _rv hand-off and the no-lint'd _stop flip",
@@ -665,6 +728,13 @@ HARNESSES: Dict[str, Harness] = {
             "crash race on the lease table and the free-chip count",
             expect_evidence=True,
             expect_keys=["ChipLeaseBroker"],
+            mutation=True),
+        _mk("mut-dist-lease-broker", lambda: _dist_lease_broker(True),
+            "MUTATION: LeaseTable._stale_locked stripped — the zombie's "
+            "stale-epoch confirm is accepted and it keeps chips the "
+            "recovery reaper should have reclaimed",
+            expect_evidence=True,
+            expect_keys=["stale-confirm accepted"],
             mutation=True),
     ]
 }
@@ -709,6 +779,14 @@ STATIC_XREF: List[Dict[str, Any]] = [
                  "(PR 15; _lock)",
         "guarded": "lease-broker",
         "mutated": "mut-lease-broker",
+    },
+    {
+        "site": "edl_tpu/runtime/lease_table.py:LeaseTable._stale_locked",
+        "claim": "epoch fencing: a holder whose remembered epoch differs "
+                 "from the lease's must be refused, or a force-released "
+                 "zombie keeps chips through recovery (PR 19)",
+        "guarded": "dist-lease-broker",
+        "mutated": "mut-dist-lease-broker",
     },
     {
         "site": "edl_tpu/cluster/kube.py:KubeJobSource._rv "

@@ -1637,8 +1637,13 @@ def run_elasticity(args) -> int:
     fake clock and fake side ports — no devices, no subprocesses, so
     an operator can see exactly when and why chips would move before
     pointing the controller at a live fleet. One tick per simulated
-    hour. ``scripts/exp_elasticity.py`` is the live-fleet analog."""
-    from edl_tpu.elasticity.broker import ChipLeaseBroker
+    hour. ``scripts/exp_elasticity.py`` is the live-fleet analog.
+
+    ``--coordinator HOST:PORT`` swaps the in-process broker for the
+    coordinator-fronted :class:`DistributedChipBroker` — same policy
+    loop, but every lease transition is WAL-persisted by the remote
+    ``edl-coordinator`` and survives its restart."""
+    from edl_tpu.elasticity.broker import ChipLeaseBroker, LeaseError
     from edl_tpu.elasticity.controller import (
         ElasticityController,
         ServePort,
@@ -1667,7 +1672,26 @@ def run_elasticity(args) -> int:
             return 2.0
         return 0.25
 
-    broker = ChipLeaseBroker(args.chips, clock=lambda: clock["t"])
+    if args.coordinator:
+        from edl_tpu.elasticity.distbroker import DistributedChipBroker
+        from edl_tpu.runtime.coordinator import CoordinatorClient
+
+        host, _, port = args.coordinator.rpartition(":")
+        if not host or not port.isdigit():
+            print(f"error: --coordinator wants HOST:PORT, got "
+                  f"{args.coordinator!r}", file=sys.stderr)
+            return 1
+        try:
+            broker = DistributedChipBroker(
+                CoordinatorClient(host, int(port)), args.chips,
+                clock=lambda: clock["t"],
+            )
+        except (LeaseError, OSError) as e:
+            print(f"error: coordinator {args.coordinator}: {e}",
+                  file=sys.stderr)
+            return 1
+    else:
+        broker = ChipLeaseBroker(args.chips, clock=lambda: clock["t"])
     train = TrainPort(
         chips=lambda: state["train_chips"],
         apply_chips=lambda n: state.update(train_chips=n),
@@ -2860,6 +2884,12 @@ def build_parser() -> argparse.ArgumentParser:
         "--cooldown-s", type=float, default=0.0,
         help="handover cooldown through the shared ScaleGate "
         "(simulated seconds; 1 tick = 3600)",
+    )
+    el.add_argument(
+        "--coordinator", default="",
+        help="HOST:PORT of a running edl-coordinator: run the policy "
+        "loop against the distributed (WAL-persisted, epoch-fenced) "
+        "lease broker instead of the in-process one",
     )
     el.add_argument("--json", action="store_true",
                     help="machine-readable ledger")

@@ -5,7 +5,9 @@ The paper's control plane continuously re-targets jobs between min and
 max instances as cluster load shifts; this package is the fusion of
 the repo's two independently-elastic sides. :mod:`broker` owns the
 chip inventory as first-class leases (GRANTED→RECALLING→FREED, epochs
-monotonic), :mod:`controller` is the policy loop that recalls from one
+monotonic), :mod:`distbroker` is the same contract fronted by the
+coordinator (WAL-persisted leases, epoch fencing, broker-restart
+recovery), :mod:`controller` is the policy loop that recalls from one
 side and grants to the other through the autoscaler's shared
 hysteresis gate, and :mod:`weightpush` is the p2p warm-start plane
 that lets a freshly granted serving replica pull live weights over
@@ -24,4 +26,7 @@ from edl_tpu.elasticity.controller import (  # noqa: F401
     ElasticityController,
     ServePort,
     TrainPort,
+)
+from edl_tpu.elasticity.distbroker import (  # noqa: F401
+    DistributedChipBroker,
 )

@@ -138,6 +138,20 @@ DEFAULT_RULES = {
             "z": 8.0,
             "severity": "warn",
         },
+        {
+            # a spike of stale-epoch fences means holders are acting on
+            # leases the broker no longer honors — split-brain in the
+            # chip inventory; one or two after a broker restart is the
+            # recovery window working, a burst is an incident
+            "type": "anomaly",
+            "name": "lease_fence_anomaly",
+            "series": "edl_lease_fenced_total",
+            "labels": {"reason": "stale_epoch"},
+            "mode": "increase",
+            "window_s": 600.0,
+            "z": 8.0,
+            "severity": "warn",
+        },
     ],
 }
 

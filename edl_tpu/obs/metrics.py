@@ -513,6 +513,16 @@ def ensure_core_series(reg: Optional[MetricsRegistry] = None) -> MetricsRegistry
         "edl_worker_heartbeat_degraded",
         "1 while the heartbeat loop cannot reach the coordinator",
     )
+    # chip-lease elasticity (elasticity/broker.py + distbroker.py)
+    r.counter(
+        "edl_lease_fenced_total",
+        "lease confirms rejected by the epoch fence",
+        ("reason",),
+    )
+    r.counter(
+        "edl_lease_recoveries_total",
+        "broker-restart recoveries completed (RECOVERING -> steady)",
+    )
     # elastic / reshard (the BASELINE north-star metric, scrapeable)
     r.counter("edl_reshard_total", "elastic reshards", ("path",))
     r.histogram("edl_reshard_stall_seconds", "traffic-stopping reshard window")

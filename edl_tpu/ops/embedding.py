@@ -182,8 +182,16 @@ def sharded_embedding_lookup(
     size. ids int32, any shape, sharded ``ids_pspec`` (default
     replicated). Returns [*ids.shape, E] sharded like the ids.
     """
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    try:
+        from jax import shard_map
+        vma_kwargs = {"check_vma": False}
+    except ImportError:
+        # pre-0.6 jax: shard_map lives in experimental and the
+        # replication-check kwarg is still called check_rep
+        from jax.experimental.shard_map import shard_map
+        vma_kwargs = {"check_rep": False}
 
     n = mesh.shape[vocab_axis]
     vocab, _ = table.shape
@@ -207,5 +215,5 @@ def sharded_embedding_lookup(
         mesh=mesh,
         in_specs=(P(vocab_axis, None), ids_pspec),
         out_specs=out_pspec,
-        check_vma=False,
+        **vma_kwargs,
     )(table, ids)

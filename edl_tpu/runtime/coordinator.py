@@ -19,17 +19,21 @@ nack/release_worker/queue_done/queue_stats.
 from __future__ import annotations
 
 import ctypes
+import json
 import os
+import random
 import socket
 import subprocess
 import threading
 import time
+import uuid
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
 from edl_tpu.obs import disttrace
 from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.runtime.data import ElasticDataQueue, Task
+from edl_tpu.runtime.lease_table import LeaseTable
 from edl_tpu.utils import faults, tracing
 from edl_tpu.utils.logging import kv_logger
 
@@ -135,6 +139,34 @@ def _parse_members(s: str) -> List[Member]:
     return out
 
 
+def _parse_lease_snap(s: str) -> Dict:
+    """Parse ``pool free epoch recovering [id|holder|chips|epoch|state|
+    confirmed,...]`` (the LSNAP payload; "|" because holders contain
+    ":") into the same dict shape LeaseTable.snap() returns."""
+    parts = s.split(" ", 4)
+    out = {
+        "pool": int(parts[0]),
+        "free": int(parts[1]),
+        "epoch": int(parts[2]),
+        "recovering": bool(int(parts[3])),
+        "leases": [],
+    }
+    if len(parts) > 4 and parts[4]:
+        for ent in parts[4].split(","):
+            lid, holder, chips, ep, st, conf = ent.split("|")
+            out["leases"].append(
+                {
+                    "id": int(lid),
+                    "holder": holder,
+                    "chips": int(chips),
+                    "epoch": int(ep),
+                    "state": int(st),
+                    "confirmed": bool(int(conf)),
+                }
+            )
+    return out
+
+
 class NativeCoordinator:
     """ctypes wrapper over the C++ core (in-process mode)."""
 
@@ -199,6 +231,36 @@ class NativeCoordinator:
         lib.edl_queue_release_worker.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.edl_queue_done.argtypes = [ctypes.c_void_p]
         lib.edl_queue_stats.argtypes = [ctypes.c_void_p, ctypes.c_longlong * 5]
+        lib.edl_lease_init.argtypes = [ctypes.c_void_p, ctypes.c_longlong]
+        lib.edl_lease_grant.restype = ctypes.c_longlong
+        lib.edl_lease_grant.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_longlong,
+            ctypes.c_char_p,
+            ctypes.c_longlong * 2,
+        ]
+        lib.edl_lease_recall.argtypes = [ctypes.c_void_p, ctypes.c_longlong]
+        lib.edl_lease_free.restype = ctypes.c_longlong
+        lib.edl_lease_free.argtypes = [ctypes.c_void_p, ctypes.c_longlong]
+        lib.edl_lease_confirm.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_longlong,
+            ctypes.c_longlong,
+        ]
+        lib.edl_lease_crashed.restype = ctypes.c_longlong
+        lib.edl_lease_crashed.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.edl_lease_expire.argtypes = [ctypes.c_void_p, ctypes.c_longlong * 2]
+        lib.edl_lease_set_recover_window.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_double,
+        ]
+        lib.edl_lease_snap.restype = ctypes.c_longlong
+        lib.edl_lease_snap.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_longlong,
+        ]
         lib.edl_wal_compact.argtypes = [ctypes.c_void_p]
         lib.edl_wal_set_compact_bytes.argtypes = [
             ctypes.c_void_p,
@@ -315,6 +377,50 @@ class NativeCoordinator:
             "epoch": out[4],
         }
 
+    # chip leases (the distributed ChipLeaseBroker backend; WAL-logged,
+    # so a SIGKILLed broker resumes with exact lease accounting)
+    def lease_init(self, total_chips: int) -> bool:
+        return bool(self._lib.edl_lease_init(self._h, total_chips))
+
+    def lease_grant(self, holder: str, chips: int, token: str = "") -> Dict:
+        token = token or uuid.uuid4().hex
+        out = (ctypes.c_longlong * 2)()
+        lid = self._lib.edl_lease_grant(
+            self._h, holder.encode(), chips, token.encode(), out
+        )
+        if lid == -2:
+            return {"ok": False, "reason": "nopool", "free": 0}
+        if lid == -1:
+            return {"ok": False, "reason": "nochips", "free": out[1]}
+        return {"ok": True, "id": lid, "epoch": out[0], "chips": out[1]}
+
+    def lease_recall(self, lease_id: int) -> str:
+        rc = self._lib.edl_lease_recall(self._h, lease_id)
+        return {0: "ok", -1: "unknown", -2: "freed"}[rc]
+
+    def lease_free(self, lease_id: int) -> int:
+        return self._lib.edl_lease_free(self._h, lease_id)
+
+    def lease_confirm(self, lease_id: int, epoch: int) -> str:
+        rc = self._lib.edl_lease_confirm(self._h, lease_id, epoch)
+        return {0: "ok", 1: "stale_epoch", 2: "freed", 3: "unknown"}[rc]
+
+    def lease_crashed(self, holder: str) -> int:
+        return self._lib.edl_lease_crashed(self._h, holder.encode())
+
+    def lease_expire(self) -> Tuple[int, int]:
+        out = (ctypes.c_longlong * 2)()
+        self._lib.edl_lease_expire(self._h, out)
+        return (out[0], out[1])
+
+    def lease_set_recover_window(self, seconds: float) -> None:
+        self._lib.edl_lease_set_recover_window(self._h, seconds)
+
+    def lease_snap(self) -> Dict:
+        buf = ctypes.create_string_buffer(262144)
+        self._lib.edl_lease_snap(self._h, buf, len(buf))
+        return _parse_lease_snap(buf.value.decode())
+
     # WAL compaction (snapshot+truncate: replay cost O(state), not
     # O(history) — the compacted-etcd-durability analog)
     def wal_compact(self) -> None:
@@ -426,7 +532,13 @@ class CoordinatorClient:
                             f"{self._reconnect_window_s:.0f}s: {e}"
                         ) from e
                     time.sleep(backoff)
-                    backoff = min(backoff * 2, 2.0)
+                    # decorrelated jitter, not plain doubling: after a
+                    # broker restart every fenced holder re-confirms at
+                    # once, and lockstep 0.05/0.1/0.2 waves would
+                    # thundering-herd the accept loop — spreading each
+                    # client's next attempt over [base, 3*prev) decoheres
+                    # them while keeping the same 2 s ceiling
+                    backoff = min(2.0, random.uniform(0.05, backoff * 3))
 
     def ping(self) -> bool:
         return self._call("PING") == "PONG"
@@ -520,6 +632,85 @@ class CoordinatorClient:
         parts = self._call("WALSTATS").split()[1:]
         return {"appended_bytes": int(parts[0]), "compactions": int(parts[1])}
 
+    # chip leases. Same graceful degradation as time(): an old server
+    # binary without the lease ops answers "ERR unknown command" and
+    # every method returns None, so callers can fall back to the
+    # in-process broker instead of failing bring-up. Holders and
+    # tokens must be space-free (":" is fine — "train:job0").
+
+    def lease_init(self, total_chips: int) -> Optional[bool]:
+        r = self._call(f"LINIT {total_chips}")
+        if r.startswith("OK"):
+            return True
+        if r == "ERR busy":
+            return False
+        return None
+
+    def lease_grant(
+        self, holder: str, chips: int, token: str = ""
+    ) -> Optional[Dict]:
+        # the token makes a retried grant (reconnect window re-issuing
+        # after a lost reply) return the original lease, not a second
+        # one — the WAL-replayed server still knows the token
+        token = token or uuid.uuid4().hex
+        r = self._call(f"LGRANT {holder} {chips} {token}")
+        if r.startswith("LEASE "):
+            _, lid, ep, ch = r.split()
+            return {
+                "ok": True, "id": int(lid), "epoch": int(ep),
+                "chips": int(ch), "token": token,
+            }
+        if r.startswith("ERR nochips"):
+            return {"ok": False, "reason": "nochips", "free": int(r.split()[2])}
+        if r == "ERR nopool":
+            return {"ok": False, "reason": "nopool", "free": 0}
+        return None
+
+    def lease_recall(self, lease_id: int) -> Optional[str]:
+        r = self._call(f"LRECALL {lease_id}")
+        if r == "OK":
+            return "ok"
+        if r.startswith("ERR unknown c"):  # old server: no lease ops
+            return None
+        if r.startswith("ERR "):
+            return r.split()[1]  # "unknown" | "freed"
+        return None
+
+    def lease_free(self, lease_id: int) -> Optional[int]:
+        r = self._call(f"LFREE {lease_id}")
+        if r.startswith("OK "):
+            return int(r.split()[1])
+        if r == "ERR unknown":
+            return -1
+        if r == "ERR freed":
+            return -2
+        return None
+
+    def lease_confirm(self, lease_id: int, epoch: int) -> Optional[str]:
+        r = self._call(f"LCONFIRM {lease_id} {epoch}")
+        if r.startswith("OK"):
+            return "ok"
+        if r.startswith("FENCED "):
+            return r.split()[1]  # "stale_epoch" | "freed" | "unknown"
+        return None
+
+    def lease_crashed(self, holder: str) -> Optional[int]:
+        r = self._call(f"LCRASH {holder}")
+        return int(r.split()[1]) if r.startswith("OK ") else None
+
+    def lease_expire(self) -> Optional[Tuple[int, int]]:
+        r = self._call("LEXPIRE")
+        if not r.startswith("OK "):
+            return None
+        _, released, recovering = r.split()
+        return (int(released), int(recovering))
+
+    def lease_snap(self) -> Optional[Dict]:
+        r = self._call("LSNAP")
+        if not r.startswith("LEASES "):
+            return None
+        return _parse_lease_snap(r[7:])
+
 
 class CoordinatorServer:
     """Spawn/own an edl-coordinator process (per-job coordinator pod
@@ -536,6 +727,7 @@ class CoordinatorServer:
         member_ttl_s: float = 10.0,
         wal_path: str = "",
         wal_compact_bytes: int = 0,  # 0 = server default (1 MiB)
+        lease_recover_s: float = -1.0,  # <0 = server default (5 s)
     ):
         if not ensure_native_built():
             raise RuntimeError("native coordinator unavailable")
@@ -548,6 +740,7 @@ class CoordinatorServer:
         self.member_ttl_s = member_ttl_s
         self.wal_path = wal_path
         self.wal_compact_bytes = wal_compact_bytes
+        self.lease_recover_s = lease_recover_s
         self._spawn()
 
     def _spawn(self) -> None:
@@ -560,6 +753,10 @@ class CoordinatorServer:
             cmd += ["--wal", self.wal_path]
         if self.wal_compact_bytes > 0:
             cmd += ["--wal-compact-bytes", str(self.wal_compact_bytes)]
+        if self.lease_recover_s >= 0:
+            # chip-lease recovery window: how long a restarted broker
+            # waits for holders to re-confirm before force-releasing
+            cmd += ["--lease-recover", str(self.lease_recover_s)]
         self._proc = subprocess.Popen(
             cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT
         )
@@ -612,6 +809,9 @@ class PyCoordinator:
         self._epoch = 0
         self._barriers: Dict[str, set] = {}
         self._queue: Optional[ElasticDataQueue] = None
+        # chip leases: the shared state machine, persisting its doc
+        # into this KV (the memory-only analog of the native WAL)
+        self._lease_table = LeaseTable(persist=self._lease_persist)
 
     def kv_put(self, k, v):
         with self._lock:
@@ -711,6 +911,52 @@ class PyCoordinator:
 
     def queue_stats(self):
         return self._queue.progress() if self._queue else {}
+
+    # chip leases: delegate to the shared LeaseTable (same return
+    # values as the native bindings, so the client adapter can't tell
+    # the backends apart)
+    def _lease_persist(self, doc):
+        self.kv_put("lease/table", json.dumps(doc, sort_keys=True))
+
+    def lease_restore(self):
+        """Simulate a broker restart: rebuild the lease table from the
+        persisted KV doc. Live leases come back unconfirmed and the
+        table enters RECOVERING — the WAL-replay analog for the
+        memory-only fallback (tests crash the table, then restore)."""
+        doc = self.kv_get("lease/table")
+        window = self._lease_table.recover_window_s
+        self._lease_table = LeaseTable(
+            persist=self._lease_persist, recover_window_s=window
+        )
+        if doc:
+            self._lease_table.restore(json.loads(doc))
+
+    def lease_init(self, total_chips):
+        return self._lease_table.init(total_chips)
+
+    def lease_grant(self, holder, chips, token=""):
+        return self._lease_table.grant(holder, chips, token or uuid.uuid4().hex)
+
+    def lease_recall(self, lease_id):
+        return self._lease_table.recall(lease_id)
+
+    def lease_free(self, lease_id):
+        return self._lease_table.free(lease_id)
+
+    def lease_confirm(self, lease_id, epoch):
+        return self._lease_table.confirm(lease_id, epoch)
+
+    def lease_crashed(self, holder):
+        return self._lease_table.crashed(holder)
+
+    def lease_expire(self):
+        return self._lease_table.expire()
+
+    def lease_set_recover_window(self, seconds):
+        self._lease_table.recover_window_s = seconds
+
+    def lease_snap(self):
+        return self._lease_table.snap()
 
     # WAL interface parity (duck-typed with NativeCoordinator): the
     # Python fallback is memory-only, so these are honest no-ops

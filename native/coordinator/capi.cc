@@ -106,6 +106,55 @@ void edl_queue_stats(void* h, long long out[5]) {
   for (int i = 0; i < 5; ++i) out[i] = s[i];
 }
 
+// Chip leases (distributed ChipLeaseBroker backend). Grant returns the
+// lease id (>=1) or -1 nochips / -2 nopool; out = [epoch, chips|free].
+int edl_lease_init(void* h, long long total) {
+  return static_cast<Coordinator*>(h)->LeaseInit(total) ? 1 : 0;
+}
+long long edl_lease_grant(void* h, const char* holder, long long chips,
+                          const char* token, long long out[2]) {
+  int64_t o[2];
+  int64_t id = static_cast<Coordinator*>(h)->LeaseGrant(
+      holder, chips, token ? token : "", o);
+  out[0] = o[0];
+  out[1] = o[1];
+  return id;
+}
+int edl_lease_recall(void* h, long long id) {
+  return static_cast<Coordinator*>(h)->LeaseRecall(id);
+}
+long long edl_lease_free(void* h, long long id) {
+  return static_cast<Coordinator*>(h)->LeaseFree(id);
+}
+// 0 ok, 1 stale epoch, 2 freed, 3 unknown.
+int edl_lease_confirm(void* h, long long id, long long epoch) {
+  return static_cast<Coordinator*>(h)->LeaseConfirm(id, epoch);
+}
+long long edl_lease_crashed(void* h, const char* holder) {
+  return static_cast<Coordinator*>(h)->LeaseCrashed(holder);
+}
+// out: [force-released this sweep, still-recovering 0|1]
+void edl_lease_expire(void* h, long long out[2]) {
+  int64_t o[2];
+  static_cast<Coordinator*>(h)->LeaseExpire(o);
+  out[0] = o[0];
+  out[1] = o[1];
+}
+void edl_lease_set_recover_window(void* h, double seconds) {
+  static_cast<Coordinator*>(h)->SetLeaseRecoverWindow(seconds);
+}
+// Snapshot serialized into caller buffer; returns needed length.
+long long edl_lease_snap(void* h, char* buf, long long buflen) {
+  std::string s = static_cast<Coordinator*>(h)->LeaseSnap();
+  long long n = static_cast<long long>(s.size());
+  if (buf && buflen > 0) {
+    long long c = n < buflen - 1 ? n : buflen - 1;
+    std::memcpy(buf, s.data(), static_cast<size_t>(c));
+    buf[c] = '\0';
+  }
+  return n;
+}
+
 // WAL compaction: force a snapshot+truncate / tune the auto threshold /
 // read [appended bytes since last compaction, compaction count].
 void edl_wal_compact(void* h) { static_cast<Coordinator*>(h)->Compact(); }

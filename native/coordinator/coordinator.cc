@@ -82,6 +82,12 @@ double Coordinator::Now() {
 //   A <id>                                ack
 //   N <id>                                nack
 //   W <worker>                            release all of worker's leases
+//   LP <total>                            chip-lease pool init
+//   LG <id> <holder> <chips> <epoch> <token>   chip lease granted
+//   LR <id>                               chip lease recall started
+//   LF <id>                               chip lease freed (chips back)
+//   LK <holder>                           holder crashed: settle its leases
+//   LE <id> ...                           one recovery sweep (force-released)
 
 Coordinator::Coordinator(double member_ttl_s, const std::string& wal_path)
     : member_ttl_s_(member_ttl_s), wal_path_(wal_path) {
@@ -103,6 +109,26 @@ Coordinator::Coordinator(double member_ttl_s, const std::string& wal_path)
   // forever. Re-run the advance check here (wal_ is open: the G is
   // logged this time).
   if (queue_ready_ && todo_.empty() && leases_.empty()) AdvanceEpochLocked();
+  // chip-lease recovery: replayed live leases are unconfirmed (confirms
+  // are session-local, like TTLs). Recompute free from first principles
+  // so conservation (leased + free == pool) holds no matter where in a
+  // mutation the previous process died, then demand re-confirmation.
+  if (lease_pool_ > 0) {
+    int64_t live = 0;
+    bool any_live = false;
+    for (auto& [id, l] : chip_leases_) {
+      if (l.state != 2) {
+        live += l.chips;
+        l.confirmed = false;
+        any_live = true;
+      }
+    }
+    lease_free_ = lease_pool_ - live;
+    if (any_live) {
+      lease_recovering_ = true;
+      lease_recover_started_ = Now();
+    }
+  }
 }
 
 Coordinator::~Coordinator() {
@@ -229,6 +255,21 @@ bool Coordinator::WriteSnapshotLocked(std::FILE* f) {
     }
     for (const auto& t : dead_) line("SD " + task_fields(t));
   }
+  if (lease_pool_ > 0) {
+    std::ostringstream os;
+    os << "SLP " << lease_pool_ << " " << lease_epoch_ << " "
+       << next_lease_id_;
+    line(os.str());
+    // only live leases are state; FREED records are history
+    for (const auto& [id, l] : chip_leases_) {
+      if (l.state == 2) continue;
+      std::ostringstream ls;
+      ls << "SLL " << l.id << " " << EscapeWal(l.holder, true) << " "
+         << l.chips << " " << l.epoch << " " << l.state << " "
+         << EscapeWal(l.token, true);
+      line(ls.str());
+    }
+  }
   return ok;
 }
 
@@ -343,6 +384,61 @@ void Coordinator::WalApplyLocked(const std::string& line, double now) {
         ++it;
       }
     }
+  } else if (op == "LP") {
+    int64_t total = 0;
+    in >> total;
+    lease_pool_ = total;
+    lease_free_ = total;
+    chip_leases_.clear();
+  } else if (op == "LG") {
+    long long id = 0, chips = 0, ep = 0;
+    std::string h, tok;
+    in >> id >> h >> chips >> ep >> tok;
+    LeaseGrantLocked(UnescapeWal(h), chips, UnescapeWal(tok), ep, id);
+  } else if (op == "LR") {
+    long long id = 0;
+    in >> id;
+    auto it = chip_leases_.find(id);
+    if (it != chip_leases_.end() && it->second.state == 0)
+      it->second.state = 1;
+  } else if (op == "LF") {
+    long long id = 0;
+    in >> id;
+    auto it = chip_leases_.find(id);
+    if (it != chip_leases_.end()) LeaseSettleLocked(&it->second);
+  } else if (op == "LK") {
+    std::string h;
+    in >> h;
+    const std::string holder = UnescapeWal(h);
+    for (auto& [id, l] : chip_leases_) {
+      if (l.holder == holder) LeaseSettleLocked(&l);
+    }
+  } else if (op == "LE") {
+    long long id = 0;
+    while (in >> id) {
+      auto it = chip_leases_.find(id);
+      if (it != chip_leases_.end()) LeaseSettleLocked(&it->second);
+    }
+  } else if (op == "SLP") {
+    // snapshot: pool config + exact epoch/next-id; SLL lines carry the
+    // exact live-lease population (free is recomputed in the ctor)
+    in >> lease_pool_ >> lease_epoch_ >> next_lease_id_;
+    lease_free_ = lease_pool_;
+    chip_leases_.clear();
+  } else if (op == "SLL") {
+    ChipLease l;
+    long long id = 0, chips = 0, ep = 0;
+    int32_t st = 0;
+    std::string h, tok;
+    in >> id >> h >> chips >> ep >> st >> tok;
+    l.id = id;
+    l.holder = UnescapeWal(h);
+    l.chips = chips;
+    l.epoch = ep;
+    l.state = st;
+    l.token = UnescapeWal(tok);
+    chip_leases_[l.id] = l;
+    lease_free_ -= chips;
   } else if (op == "SE") {
     // snapshot: exact epoch (the snapshot's R lines each bumped it)
     in >> epoch_;
@@ -507,6 +603,206 @@ int32_t Coordinator::BarrierCount(const std::string& name) const {
   std::lock_guard<std::mutex> lock(mu_);
   auto it = barriers_.find(name);
   return it == barriers_.end() ? 0 : static_cast<int32_t>(it->second.size());
+}
+
+// ------------------------------------------------------- chip leases
+//
+// The distributed backend of edl_tpu/elasticity's ChipLeaseBroker: one
+// shared chip pool, leases fenced by a globally monotonic epoch, every
+// transition WAL-logged so a SIGKILLed broker restarts with exact
+// accounting. Conservation (sum of live chips + free == pool) is the
+// invariant every path preserves.
+
+int64_t Coordinator::LeaseGrantLocked(const std::string& holder,
+                                      int64_t chips, const std::string& token,
+                                      int64_t epoch, int64_t id) {
+  ChipLease l;
+  l.id = id;
+  l.holder = holder;
+  l.token = token;
+  l.chips = chips;
+  l.epoch = epoch;
+  l.state = 0;
+  // the live grantee just talked to us; a replayed grantee must
+  // re-confirm (confirms are session-local, like member TTLs)
+  l.confirmed = !replaying_;
+  chip_leases_[id] = l;
+  lease_free_ -= chips;
+  if (epoch > lease_epoch_) lease_epoch_ = epoch;
+  if (id >= next_lease_id_) next_lease_id_ = id + 1;
+  return id;
+}
+
+void Coordinator::LeaseSettleLocked(ChipLease* l) {
+  if (l->state == 2) return;  // settling is idempotent
+  l->state = 2;
+  lease_free_ += l->chips;
+}
+
+bool Coordinator::LeaseAllConfirmedLocked() const {
+  for (const auto& [id, l] : chip_leases_) {
+    if (l.state != 2 && !l.confirmed) return false;
+  }
+  return true;
+}
+
+bool Coordinator::LeaseInit(int64_t total_chips) {
+  std::lock_guard<std::mutex> lock(mu_);
+  MaybeCompactLocked();
+  if (lease_pool_ == total_chips && lease_pool_ > 0) return true;
+  for (const auto& [id, l] : chip_leases_) {
+    if (l.state != 2) return false;  // live leases: pool is busy
+  }
+  lease_pool_ = total_chips;
+  lease_free_ = total_chips;
+  chip_leases_.clear();
+  // lease_epoch_ / next_lease_id_ are deliberately NOT reset: fencing
+  // depends on global monotonicity across pool re-inits
+  WalAppendLocked("LP " + std::to_string(total_chips));
+  return true;
+}
+
+int64_t Coordinator::LeaseGrant(const std::string& holder, int64_t chips,
+                                const std::string& token, int64_t out[2]) {
+  std::lock_guard<std::mutex> lock(mu_);
+  MaybeCompactLocked();
+  out[0] = 0;
+  out[1] = 0;
+  if (lease_pool_ <= 0) return -2;
+  if (!token.empty()) {
+    for (auto& [id, l] : chip_leases_) {
+      if (l.state != 2 && l.token == token) {
+        // retried grant (lost reply / post-restart replay): the original
+        // lease, unchanged — no chips move, no epoch bump
+        l.confirmed = true;
+        out[0] = l.epoch;
+        out[1] = l.chips;
+        return l.id;
+      }
+    }
+  }
+  if (chips <= 0 || chips > lease_free_) {
+    out[1] = lease_free_;
+    return -1;
+  }
+  int64_t id = next_lease_id_++;
+  int64_t epoch = ++lease_epoch_;
+  LeaseGrantLocked(holder, chips, token, epoch, id);
+  std::ostringstream os;
+  os << "LG " << id << " " << EscapeWal(holder, true) << " " << chips << " "
+     << epoch << " " << EscapeWal(token, true);
+  WalAppendLocked(os.str());
+  out[0] = epoch;
+  out[1] = chips;
+  return id;
+}
+
+int32_t Coordinator::LeaseRecall(int64_t id) {
+  std::lock_guard<std::mutex> lock(mu_);
+  MaybeCompactLocked();
+  auto it = chip_leases_.find(id);
+  if (it == chip_leases_.end()) return -1;
+  if (it->second.state == 2) return -2;
+  if (it->second.state == 0) {
+    it->second.state = 1;
+    WalAppendLocked("LR " + std::to_string(id));
+  }
+  return 0;  // re-recalling a RECALLING lease is idempotent
+}
+
+int64_t Coordinator::LeaseFree(int64_t id) {
+  std::lock_guard<std::mutex> lock(mu_);
+  MaybeCompactLocked();
+  auto it = chip_leases_.find(id);
+  if (it == chip_leases_.end()) return -1;
+  if (it->second.state == 2) return -2;
+  int64_t chips = it->second.chips;
+  LeaseSettleLocked(&it->second);
+  WalAppendLocked("LF " + std::to_string(id));
+  if (lease_recovering_ && LeaseAllConfirmedLocked()) {
+    lease_recovering_ = false;
+  }
+  return chips;
+}
+
+int32_t Coordinator::LeaseConfirm(int64_t id, int64_t epoch) {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = chip_leases_.find(id);
+  if (it == chip_leases_.end()) return 3;
+  if (it->second.state == 2) return 2;
+  if (epoch != it->second.epoch) return 1;  // stale holder: fenced
+  it->second.confirmed = true;  // session-local: no WAL entry
+  if (lease_recovering_ && LeaseAllConfirmedLocked()) {
+    lease_recovering_ = false;  // everyone re-confirmed: recovery over
+  }
+  return 0;
+}
+
+int64_t Coordinator::LeaseCrashed(const std::string& holder) {
+  std::lock_guard<std::mutex> lock(mu_);
+  MaybeCompactLocked();
+  int64_t chips = 0;
+  bool any = false;
+  for (auto& [id, l] : chip_leases_) {
+    if (l.state != 2 && l.holder == holder) {
+      chips += l.chips;
+      LeaseSettleLocked(&l);
+      any = true;
+    }
+  }
+  if (any) {
+    WalAppendLocked("LK " + EscapeWal(holder, true));
+    if (lease_recovering_ && LeaseAllConfirmedLocked()) {
+      lease_recovering_ = false;
+    }
+  }
+  return chips;
+}
+
+void Coordinator::LeaseExpire(int64_t out[2]) {
+  std::lock_guard<std::mutex> lock(mu_);
+  MaybeCompactLocked();
+  out[0] = 0;
+  out[1] = 0;
+  if (!lease_recovering_) return;
+  if (LeaseAllConfirmedLocked()) {
+    lease_recovering_ = false;
+    return;
+  }
+  if (Now() < lease_recover_started_ + lease_recover_window_s_) {
+    out[1] = 1;  // still inside the re-confirmation window
+    return;
+  }
+  // deadline passed: force-release exactly the silent holders
+  std::string ids;
+  for (auto& [id, l] : chip_leases_) {
+    if (l.state != 2 && !l.confirmed) {
+      ids += (ids.empty() ? "" : " ") + std::to_string(id);
+      LeaseSettleLocked(&l);
+      ++out[0];
+    }
+  }
+  if (!ids.empty()) WalAppendLocked("LE " + ids);
+  lease_recovering_ = false;
+}
+
+void Coordinator::SetLeaseRecoverWindow(double seconds) {
+  std::lock_guard<std::mutex> lock(mu_);
+  lease_recover_window_s_ = seconds;
+}
+
+std::string Coordinator::LeaseSnap() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  std::ostringstream os;
+  os << lease_pool_ << " " << lease_free_ << " " << lease_epoch_ << " "
+     << (lease_recovering_ ? 1 : 0);
+  bool first = true;
+  for (const auto& [id, l] : chip_leases_) {
+    os << (first ? " " : ",") << l.id << "|" << l.holder << "|" << l.chips
+       << "|" << l.epoch << "|" << l.state << "|" << (l.confirmed ? 1 : 0);
+    first = false;
+  }
+  return os.str();
 }
 
 // -------------------------------------------------------- task queue

@@ -51,6 +51,22 @@ struct MemberInfo {
   int32_t rank = -1;  // dense rank: index in sorted live-member names
 };
 
+// One chip lease in the distributed chip market (the coordinator-fronted
+// backend of edl_tpu/elasticity ChipLeaseBroker). state: 0=GRANTED,
+// 1=RECALLING, 2=FREED. `confirmed` is session-local liveness — like
+// member TTLs it is NOT persisted; every live lease replays unconfirmed
+// and the broker enters RECOVERING until holders re-confirm or the
+// recovery window force-releases them.
+struct ChipLease {
+  int64_t id = -1;
+  std::string holder;  // "side:name" (train:job0, serve:fleet, ...)
+  std::string token;   // client idempotency token (retry-safe LGRANT)
+  int64_t chips = 0;
+  int64_t epoch = 0;  // global lease epoch at grant — the fencing token
+  int32_t state = 0;
+  bool confirmed = false;
+};
+
 class Coordinator {
  public:
   explicit Coordinator(double member_ttl_s = 10.0,
@@ -95,6 +111,31 @@ class Coordinator {
   // todo, leased, done, dead, epoch
   void QueueStats(int64_t out[5]);
 
+  // -- chip leases (distributed ChipLeaseBroker backend) ---------------
+  // Pool init; idempotent on the same total. Re-sizing is only allowed
+  // while no lease is live. Returns false on a busy pool.
+  bool LeaseInit(int64_t total_chips);
+  // Grant `chips` to `holder`. Returns the lease id (>=1), or -1 when
+  // the free pool is short (out[1] = free), or -2 when the pool was
+  // never initialised. out[0] = lease epoch, out[1] = chips granted.
+  // Idempotent on `token` among live leases: a retried LGRANT (lost
+  // reply, post-restart replay) returns the original lease unchanged.
+  int64_t LeaseGrant(const std::string& holder, int64_t chips,
+                     const std::string& token, int64_t out[2]);
+  int32_t LeaseRecall(int64_t id);  // 0 ok (idempotent), -1 unknown, -2 freed
+  int64_t LeaseFree(int64_t id);    // chips returned; -1 unknown, -2 freed
+  // Fencing check: 0 ok, 1 stale epoch, 2 freed, 3 unknown. Confirms
+  // are session-local (not WAL-logged, same policy as member TTLs).
+  int32_t LeaseConfirm(int64_t id, int64_t epoch);
+  int64_t LeaseCrashed(const std::string& holder);  // chips force-released
+  // Recovery reaper: after the recover window, force-release every live
+  // lease that has not re-confirmed. out[0] = leases force-released this
+  // call, out[1] = 1 while still RECOVERING else 0.
+  void LeaseExpire(int64_t out[2]);
+  void SetLeaseRecoverWindow(double seconds);
+  // "pool free epoch recovering[ id|holder|chips|epoch|state|confirmed,...]"
+  std::string LeaseSnap() const;
+
   // -- WAL compaction ---------------------------------------------------
   // Snapshot the full state into a fresh log and truncate: replay cost
   // becomes O(state), not O(history). Auto-triggered whenever the
@@ -128,6 +169,11 @@ class Coordinator {
   int64_t RegisterLocked(const std::string& worker, int64_t inc);
   void QueueInitLocked(int64_t n_samples, int64_t chunk, int32_t passes,
                        double lease_timeout_s, int32_t max_failures);
+  int64_t LeaseGrantLocked(const std::string& holder, int64_t chips,
+                           const std::string& token, int64_t epoch,
+                           int64_t id);
+  void LeaseSettleLocked(ChipLease* l);  // FREED + chips back to free
+  bool LeaseAllConfirmedLocked() const;
   bool AckLocked(int64_t task_id);
   bool NackLocked(int64_t task_id);
   void RequeueByIdLocked(int64_t task_id);  // lease-timeout path (O op)
@@ -153,6 +199,15 @@ class Coordinator {
   int64_t epoch_ = 0;
 
   std::map<std::string, std::map<std::string, bool>> barriers_;
+
+  std::map<int64_t, ChipLease> chip_leases_;
+  int64_t lease_pool_ = 0;  // 0 = pool not initialised
+  int64_t lease_free_ = 0;
+  int64_t lease_epoch_ = 0;  // globally monotonic; never reset
+  int64_t next_lease_id_ = 1;
+  bool lease_recovering_ = false;
+  double lease_recover_started_ = 0;
+  double lease_recover_window_s_ = 5.0;
 
   std::deque<Task> todo_;
   struct LeaseRec {

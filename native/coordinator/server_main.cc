@@ -22,6 +22,21 @@
 //   QSTATS                      -> STATS todo leased done dead epoch
 //   PING                        -> PONG
 //   TIME                        -> TIME <epoch_micros>   (clock sync)
+//
+// Chip-lease ops (the distributed ChipLeaseBroker backend; holders and
+// tokens must be space-free, ":" is fine). Old servers answer
+// "ERR unknown command" and clients degrade gracefully (TIME pattern):
+//   LINIT <total>               -> OK <total> | ERR busy
+//   LGRANT <holder> <chips> <token> -> LEASE <id> <epoch> <chips>
+//                                    | ERR nochips <free> | ERR nopool
+//   LRECALL <id>                -> OK | ERR unknown | ERR freed
+//   LFREE <id>                  -> OK <chips> | ERR unknown | ERR freed
+//   LCONFIRM <id> <epoch>       -> OK <epoch>
+//                                | FENCED stale_epoch|freed|unknown
+//   LCRASH <holder>             -> OK <chips>
+//   LEXPIRE                     -> OK <released> <recovering>
+//   LSNAP                       -> LEASES <pool> <free> <epoch> <recov>
+//                                  [id|holder|chips|epoch|state|conf,...]
 
 #include <arpa/inet.h>
 #include <netinet/in.h>
@@ -149,6 +164,59 @@ std::string Handle(const std::string& line) {
     for (int i = 0; i < 5; ++i) out += " " + std::to_string(s[i]);
     return out;
   }
+  if (cmd == "LINIT") {
+    long long total = 0;
+    in >> total;
+    if (!g_coord->LeaseInit(total)) return "ERR busy";
+    return "OK " + std::to_string(total);
+  }
+  if (cmd == "LGRANT") {
+    std::string holder, token;
+    long long chips = 0;
+    in >> holder >> chips >> token;
+    int64_t out[2];
+    int64_t id = g_coord->LeaseGrant(holder, chips, token, out);
+    if (id == -2) return "ERR nopool";
+    if (id == -1) return "ERR nochips " + std::to_string(out[1]);
+    return "LEASE " + std::to_string(id) + " " + std::to_string(out[0]) +
+           " " + std::to_string(out[1]);
+  }
+  if (cmd == "LRECALL") {
+    long long id = -1;
+    in >> id;
+    int rc = g_coord->LeaseRecall(id);
+    if (rc == -1) return "ERR unknown";
+    if (rc == -2) return "ERR freed";
+    return "OK";
+  }
+  if (cmd == "LFREE") {
+    long long id = -1;
+    in >> id;
+    long long chips = g_coord->LeaseFree(id);
+    if (chips == -1) return "ERR unknown";
+    if (chips == -2) return "ERR freed";
+    return "OK " + std::to_string(chips);
+  }
+  if (cmd == "LCONFIRM") {
+    long long id = -1, epoch = -1;
+    in >> id >> epoch;
+    int rc = g_coord->LeaseConfirm(id, epoch);
+    if (rc == 1) return "FENCED stale_epoch";
+    if (rc == 2) return "FENCED freed";
+    if (rc == 3) return "FENCED unknown";
+    return "OK " + std::to_string(epoch);
+  }
+  if (cmd == "LCRASH") {
+    std::string holder;
+    in >> holder;
+    return "OK " + std::to_string(g_coord->LeaseCrashed(holder));
+  }
+  if (cmd == "LEXPIRE") {
+    int64_t o[2];
+    g_coord->LeaseExpire(o);
+    return "OK " + std::to_string(o[0]) + " " + std::to_string(o[1]);
+  }
+  if (cmd == "LSNAP") return "LEASES " + g_coord->LeaseSnap();
   if (cmd == "COMPACT") {  // snapshot+truncate the WAL now
     g_coord->Compact();
     return "OK";
@@ -190,9 +258,13 @@ int main(int argc, char** argv) {
   double ttl = 10.0;
   const char* wal = "";
   long long compact_bytes = 0;  // 0 = library default (1 MiB)
+  double lease_recover = -1.0;  // <0 = library default (5 s)
   for (int i = 1; i < argc - 1; ++i) {
     if (!strcmp(argv[i], "--port")) port = atoi(argv[i + 1]);
     if (!strcmp(argv[i], "--member-ttl")) ttl = atof(argv[i + 1]);
+    // chip-lease recovery window: seconds a restarted broker waits for
+    // holders to re-confirm before force-releasing the silent ones
+    if (!strcmp(argv[i], "--lease-recover")) lease_recover = atof(argv[i + 1]);
     // durability: replay + append the write-ahead log (etcd analog) —
     // a restarted coordinator resumes with exact KV/queue accounting
     if (!strcmp(argv[i], "--wal")) wal = argv[i + 1];
@@ -214,6 +286,7 @@ int main(int argc, char** argv) {
   }
   g_coord = new edl::Coordinator(ttl, wal);
   if (compact_bytes > 0) g_coord->SetWalCompactBytes(compact_bytes);
+  if (lease_recover >= 0) g_coord->SetLeaseRecoverWindow(lease_recover);
 
   int srv = socket(AF_INET, SOCK_STREAM, 0);
   int one = 1;

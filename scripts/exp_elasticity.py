@@ -53,6 +53,238 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+
+def run_dist_chaos(argv):
+    """Multi-process chip-lease chaos: a REAL ``edl-coordinator`` (WAL
+    on disk) fronting the :class:`DistributedChipBroker`, exercised by
+    this parent plus holder subprocesses, with the three distributed
+    failure modes the tentpole promises to survive:
+
+    1. **broker SIGKILLed mid-handover** — recall sent, then the
+       coordinator dies and respawns from its WAL; the settle RPC rides
+       the client reconnect window (plus one injected ``lease.rpc``
+       drop) and recovery re-confirms the survivors;
+    2. **holder dies holding a lease** — a ``--mode die`` subprocess
+       SIGKILLs itself mid-lease; the supervisor settles it with
+       ``holder_crashed`` and the chips come back;
+    3. **partition between confirm and grant + zombie** — an injected
+       ``lease.confirm`` drop mid-recovery, a silent holder
+       force-released by the recovery reaper, its chips re-granted,
+       and the zombie's stale re-confirm provably FENCED.
+
+    Hard invariants: zero lost/duplicated chips (conservation at the
+    coordinator after every lane, pool fully free at exit), every
+    injected ``lease.*`` fault's recovery chain closed
+    (``edl postmortem --assert-recovered --sites lease.`` over the
+    merged multi-process dump), and the zombie fenced. ``--twin`` runs
+    the same workload shape with ZERO chaos and asserts zero fence
+    events and a clean ``verify_no_incidents``.
+    """
+    import shutil
+    import subprocess
+    import tempfile as _tempfile
+
+    from edl_tpu.elasticity.distbroker import DistributedChipBroker
+    from edl_tpu.obs import events as flight
+    from edl_tpu.obs import postmortem as pm
+    from edl_tpu.obs.events import load_jsonl
+    from edl_tpu.runtime.coordinator import (
+        CoordinatorClient,
+        CoordinatorServer,
+    )
+    from edl_tpu.utils import faults
+
+    ap = argparse.ArgumentParser(
+        description="multi-process distributed chip-lease chaos lane"
+    )
+    ap.add_argument("--dist-chaos", action="store_true")
+    ap.add_argument("--twin", action="store_true",
+                    help="fault-free twin: same workload, zero chaos, "
+                    "zero fence events expected")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--events-dir", default=None,
+                    help="dump the merged multi-process timeline here "
+                    "(chaos-dist-lease.jsonl)")
+    args = ap.parse_args(argv)
+    assert not faults.armed(), (
+        "refusing to run with a pre-armed EDL_FAULTS plan: the harness "
+        "owns the fault schedule"
+    )
+    if args.events_dir:
+        os.makedirs(args.events_dir, exist_ok=True)
+    flight.default_recorder().set_context(worker="parent")
+
+    d = _tempfile.mkdtemp(prefix="edl-dist-chaos-")
+    holder_dumps = []
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    holder_env = dict(os.environ)
+    holder_env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (repo_root, holder_env.get("PYTHONPATH", "")) if p
+    )
+
+    def holder(*extra):
+        """One lease holder as a real OS process."""
+        dump = os.path.join(d, f"holder-{len(holder_dumps)}.jsonl")
+        holder_dumps.append(dump)
+        return subprocess.run(
+            [sys.executable, "-m", "edl_tpu.elasticity.holder",
+             "--coordinator", f"127.0.0.1:{srv.port}", "--total", "8",
+             "--events-out", dump, *extra],
+            capture_output=True, text=True, timeout=120, env=holder_env,
+        ), dump
+
+    def with_retry(fn, site):
+        """The holder-side recovery contract (the script plays the
+        controller): one retry over a dropped RPC, then the recovery
+        event that closes the postmortem chain."""
+        try:
+            return fn()
+        except (faults.InjectedFault, ConnectionError, OSError):
+            out = fn()
+            flight.emit("lease.recover", site=site, worker="parent",
+                        rids=[], retried=True)
+            return out
+
+    ok = False
+    srv = CoordinatorServer(
+        port=0, wal_path=os.path.join(d, "coord.wal"), lease_recover_s=0.6
+    )
+    cli = CoordinatorClient("127.0.0.1", srv.port)
+    try:
+        broker = DistributedChipBroker(cli, 8)
+        l_train = broker.grant("train:job0", 4)
+        l_serve = broker.grant("serve:r0", 2)
+        assert broker.free_chips == 2 and broker.check_conservation()
+
+        if args.twin:
+            # same workload shape, zero chaos: one well-behaved holder
+            # subprocess plus a clean recall/free lifecycle
+            r, _ = holder("--holder", "serve:h1", "--chips", "2",
+                          "--mode", "confirm", "--hold-s", "0.3")
+            assert r.returncode == 0, (r.returncode, r.stderr)
+            broker.recall(l_train.lease_id)
+            assert broker.free(l_train.lease_id) == 4
+            broker.recall(l_serve.lease_id)
+            assert broker.free(l_serve.lease_id) == 2
+            assert broker.free_chips == 8 and broker.check_conservation()
+        else:
+            print("== lane 1: broker SIGKILLed mid-handover ==")
+            broker.recall(l_train.lease_id)
+            srv.kill()   # SIGKILL, mid-handover: recall persisted,
+            srv._spawn()  # settle pending; respawn replays the WAL
+            faults.arm("lease.rpc:drop@n=1,max=1", seed=args.seed)
+            try:
+                chips = with_retry(
+                    lambda: broker.free(l_train.lease_id), "lease.rpc"
+                )
+            finally:
+                faults.disarm()
+            assert chips == 4, chips
+            res = broker.resync()
+            assert not res["recovering"], res
+            assert broker.check_conservation() and broker.free_chips == 6
+            print(f"  broker restarted, handover settled, "
+                  f"free={broker.free_chips}")
+
+            print("== lane 2: holder dies holding a lease ==")
+            r, _ = holder("--holder", "serve:victim", "--chips", "2",
+                          "--mode", "die")
+            assert r.returncode == 9, (r.returncode, r.stderr)
+            assert r.stdout.startswith("LEASE "), r.stdout
+            assert broker.free_chips == 4  # the corpse still holds 2
+            dead = broker.holder_crashed("serve:victim")
+            assert sum(l.chips for l in dead) == 2
+            assert broker.free_chips == 6 and broker.check_conservation()
+            print("  dead holder settled, chips reclaimed")
+
+            print("== lane 3: confirm-partition + zombie fenced ==")
+            lz = broker.grant("serve:h2", 2)  # holder about to go silent
+            srv.kill()   # restart #2: every live lease must re-confirm
+            srv._spawn()
+            faults.arm("lease.confirm:drop@n=1,max=1", seed=args.seed)
+            try:
+                confirmed = with_retry(
+                    lambda: broker.confirm(l_serve.lease_id),
+                    "lease.confirm",
+                )
+            finally:
+                faults.disarm()
+            assert confirmed, "live holder fenced during recovery"
+            with broker._lock:  # h2 goes silent: resync won't speak for it
+                broker._leases.pop(lz.lease_id)
+            released, deadline = 0, time.time() + 15
+            while True:
+                res = broker.resync()
+                released += res["force_released"]
+                if not res["recovering"]:
+                    break
+                assert time.time() < deadline, "recovery never converged"
+                time.sleep(0.1)
+            assert released == 1, (  # EXACTLY the silent holder
+                f"force-released {released}, want 1 (the silent holder)"
+            )
+            assert broker.check_conservation() and broker.free_chips == 6
+            ln = broker.grant("serve:r1", 2)  # reclaimed chips, new epoch
+            r, _ = holder("--holder", "serve:h2", "--chips", "2",
+                          "--mode", "zombie",
+                          "--lease-id", lz.lease_id,
+                          "--epoch", str(lz.epoch))
+            assert r.returncode == 0 and "FENCED True" in r.stdout, (
+                r.returncode, r.stdout, r.stderr
+            )
+            print(f"  silent holder force-released, zombie fenced "
+                  f"(stale epoch {lz.epoch} vs {ln.epoch})")
+
+            # drain: zero lost/duplicated chips at the coordinator
+            for lease in broker.live():
+                broker.recall(lease.lease_id)
+                broker.free(lease.lease_id)
+            assert broker.free_chips == 8 and broker.check_conservation()
+
+        # -- merge every process's timeline + postmortem ------------------
+        recs = list(flight.default_recorder().records())
+        for dump in holder_dumps:
+            if os.path.exists(dump):
+                with open(dump) as f:
+                    recs.extend(load_jsonl(f.read()))
+        recs.sort(key=lambda e: (e.get("t_wall", 0.0), e.get("seq", 0)))
+        if args.events_dir:
+            path = os.path.join(args.events_dir, "chaos-dist-lease.jsonl")
+            with open(path, "w") as f:
+                for rec in recs:
+                    f.write(json.dumps(rec) + "\n")
+            print(f"  merged timeline -> {path} ({len(recs)} events)")
+        fences = [e for e in recs if e.get("kind") == "lease.fence"]
+        if args.twin:
+            assert not fences, f"fault-free twin fenced: {fences}"
+            probs = pm.verify_no_incidents(recs)
+            assert not probs, f"twin incidents: {probs}"
+            print("DIST TWIN OK")
+        else:
+            assert fences, "zombie never produced a lease.fence event"
+            probs = pm.verify_recovered(recs, site_prefix="lease.")
+            assert not probs, f"lease postmortem: {probs}"
+            recovers = [e for e in recs if e.get("kind") == "lease.recover"]
+            assert recovers, "no lease.recover on the merged timeline"
+            print(f"  postmortem: {len(recovers)} recoveries, "
+                  f"{len(fences)} fence(s), all chains closed")
+            print("DIST CHAOS OK")
+        ok = True
+        return 0
+    finally:
+        faults.disarm()
+        cli.close()
+        srv.stop()
+        if ok:
+            shutil.rmtree(d, ignore_errors=True)
+
+
+if "--dist-chaos" in sys.argv:
+    # the distributed lane is jax-free (coordinator + broker + holder
+    # subprocesses only) — skip the heavy imports below entirely
+    sys.exit(run_dist_chaos([a for a in sys.argv[1:]]))
+
 from edl_tpu.utils.platform import force_virtual_cpu  # noqa: E402
 
 force_virtual_cpu(8)

@@ -464,6 +464,38 @@ python -m edl_tpu.cli postmortem "$WDIR/ev.jsonl" --assert-recovered \
 rm -rf "$WDIR"
 t15=$(date +%s)
 echo "== phase 15 done in $((t15 - t14))s (rc=$rc15) =="
-echo "== total $((t15 - t0))s =="
+echo "== phase 16: distributed chip-lease chaos lane (multi-process broker + postmortem gate) =="
+# a real edl-coordinator (WAL on disk) fronting the
+# DistributedChipBroker, driven by the parent plus holder
+# SUBPROCESSES through the three distributed failure modes: broker
+# SIGKILLed mid-handover (respawns from the WAL, settle rides the
+# client reconnect window), a holder dying while holding a lease
+# (LCRASH settlement), and a confirm/grant partition whose silent
+# holder is force-released by the recovery reaper — then provably
+# FENCED when its zombie re-confirms a stale epoch. Gates: zero
+# lost/duplicated chips (conservation at the coordinator, pool fully
+# free at exit), every injected lease.* fault's recovery chain closed
+# — re-verified from OUTSIDE by `edl postmortem --assert-recovered
+# --sites lease.` over the merged multi-process dump — and a
+# fault-free twin with zero fence events and a clean incident sweep.
+DLDIR="${TMPDIR:-/tmp}/edl-dist-lease.$$"
+rm -rf "$DLDIR"
+rc16=0
+JAX_PLATFORMS=cpu python scripts/exp_elasticity.py --dist-chaos --seed 0 \
+    --events-dir "$DLDIR" || rc16=1
+f="$DLDIR/chaos-dist-lease.jsonl"
+if [ -e "$f" ]; then
+  python -m edl_tpu.cli postmortem "$f" --assert-recovered \
+      --sites lease. > /dev/null \
+    || { echo "postmortem FAILED for $f (lease.*)"; rc16=1; }
+else
+  echo "missing dist-lease dump $f"; rc16=1
+fi
+JAX_PLATFORMS=cpu python scripts/exp_elasticity.py --dist-chaos --twin \
+    --seed 0 || { echo "fault-free dist twin FAILED"; rc16=1; }
+rm -rf "$DLDIR"
+t16=$(date +%s)
+echo "== phase 16 done in $((t16 - t15))s (rc=$rc16) =="
+echo "== total $((t16 - t0))s =="
 
-[ "$rc0" -eq 0 ] && [ "$rc1" -eq 0 ] && [ "$rc2" -eq 0 ] && [ "$rc3" -eq 0 ] && [ "$rc4" -eq 0 ] && [ "$rc5" -eq 0 ] && [ "$rc6" -eq 0 ] && [ "$rc7" -eq 0 ] && [ "$rc8" -eq 0 ] && [ "$rc9" -eq 0 ] && [ "$rc10" -eq 0 ] && [ "$rc11" -eq 0 ] && [ "$rc12" -eq 0 ] && [ "$rc13" -eq 0 ] && [ "$rc14" -eq 0 ] && [ "$rc15" -eq 0 ]
+[ "$rc0" -eq 0 ] && [ "$rc1" -eq 0 ] && [ "$rc2" -eq 0 ] && [ "$rc3" -eq 0 ] && [ "$rc4" -eq 0 ] && [ "$rc5" -eq 0 ] && [ "$rc6" -eq 0 ] && [ "$rc7" -eq 0 ] && [ "$rc8" -eq 0 ] && [ "$rc9" -eq 0 ] && [ "$rc10" -eq 0 ] && [ "$rc11" -eq 0 ] && [ "$rc12" -eq 0 ] && [ "$rc13" -eq 0 ] && [ "$rc14" -eq 0 ] && [ "$rc15" -eq 0 ] && [ "$rc16" -eq 0 ]
